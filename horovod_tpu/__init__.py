@@ -1,0 +1,62 @@
+"""horovod_tpu: a TPU-native distributed training framework.
+
+Brand-new implementation of the capabilities of Horovod (reference:
+wwiiiii/horovod v0.19.2-dev) designed for TPU hardware: the data plane is XLA
+collectives over ICI/DCN driven by jit/pjit/shard_map over device meshes, the
+host plane is a light coordination layer (rendezvous, elastic membership,
+timeline, stall detection), and the hot paths are Pallas kernels. See
+SURVEY.md at the repo root for the structural mapping to the reference.
+
+Quick start (data-parallel, single controller)::
+
+    import horovod_tpu as hvd
+    hvd.init()
+    # compiled plane: shard the batch over all chips, wrap the optimizer
+    opt = hvd.DistributedOptimizer(optax.adam(1e-3 * hvd.dp_size()))
+
+Eager host-plane collectives (one value per process, reference rank
+semantics)::
+
+    out = hvd.allreduce(x, name="x")          # average across processes
+    gat = hvd.allgather(x)                    # concat along dim 0
+    y   = hvd.broadcast(x, root_rank=0)
+"""
+
+__version__ = "0.1.0"
+
+from .basics import (  # noqa: F401
+    init, shutdown, is_initialized,
+    rank, size, local_rank, local_size, cross_rank, cross_size,
+    device_count, local_device_count, dp_size, is_homogeneous,
+    process_set_mesh, hostname,
+    xla_built, tpu_available, mpi_built, mpi_enabled, gloo_built,
+    nccl_built, ccl_built, ddl_built, cuda_built, rocm_built,
+    mpi_threads_supported,
+)
+from .collectives import (  # noqa: F401
+    ReduceOp, Average, Sum, Adasum, Min, Max, Product,
+    allreduce, allreduce_async, grouped_allreduce,
+    allgather, allgather_async,
+    broadcast, broadcast_async,
+    alltoall,
+    poll, synchronize, join, joined, barrier,
+)
+from .exceptions import (  # noqa: F401
+    HorovodInternalError, HostsUpdatedInterrupt, TensorValidationError,
+    DuplicateNameError, NotInitializedError, StallError,
+)
+
+
+def __getattr__(name):
+    # Lazy surface for heavier subsystems so `import horovod_tpu` stays cheap.
+    if name in ("DistributedOptimizer", "DistributedGradientTransform"):
+        from . import optimizer
+        return getattr(optimizer, name)
+    if name in ("broadcast_parameters", "broadcast_object",
+                "broadcast_optimizer_state", "allgather_object"):
+        from . import functions
+        return getattr(functions, name)
+    if name == "Compression":
+        from .compression import Compression
+        return Compression
+    raise AttributeError(f"module 'horovod_tpu' has no attribute {name!r}")

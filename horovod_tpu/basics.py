@@ -1,0 +1,304 @@
+"""Process/world lifecycle for horovod_tpu.
+
+TPU-native analogue of the reference's ``HorovodBasics``
+(/root/reference/horovod/common/basics.py:25-215) and the C init path
+(`InitializeHorovodOnce`, common/operations.cc:611-657). Instead of spawning a
+background C++ coordination thread and rendezvousing MPI/Gloo communicators,
+``init()``:
+
+1. connects the JAX distributed runtime (coordinator address from the
+   launcher's env contract — the analogue of the Gloo HTTP rendezvous,
+   gloo/gloo_context.cc:70-171) when running multi-process;
+2. builds the eager-plane :class:`~horovod_tpu.mesh.WorldMesh`;
+3. starts host-side services (timeline, stall inspector, async coordinator).
+
+Rank semantics (documented departure from the reference): the reference runs
+one process per GPU, so ``rank`` is both a process and a device. On TPU the
+single-controller model runs one process per *host* and addresses devices
+through meshes, so:
+
+* ``rank()/size()`` are **process**-granular (what eager collectives reduce
+  over);
+* ``device_count()/local_device_count()`` are chip-granular;
+* inside compiled code, per-device identity comes from
+  ``jax.lax.axis_index(axis)`` over the training mesh.
+
+For learning-rate scaling in data-parallel training use
+``horovod_tpu.dp_size()`` (= devices on the data axis), the moral equivalent
+of the reference's ``hvd.size()`` in its GPU-per-process world.
+"""
+
+import atexit
+import os
+import socket
+import threading
+from typing import Optional, Sequence
+
+from . import config as _config
+from .exceptions import NotInitializedError
+
+_lock = threading.Lock()
+_world: Optional["World"] = None
+
+
+class World:
+    """Singleton world state (reference: HorovodGlobalState,
+    common/global_state.h:42-122)."""
+
+    def __init__(self, cfg: _config.Config):
+        self.config = cfg
+        self.process_id = 0
+        self.num_processes = 1
+        self.coordinator_addr = ""
+        self.world_mesh = None          # WorldMesh, built in init()
+        self.controller = None          # set when multi-process
+        self.coordinator = None         # async fusion coordinator (lazy)
+        self.timeline = None
+        self.stall_inspector = None
+        self.parameter_manager = None
+        self.process_sets = {}
+        self.joined = False
+        self.shutdown_requested = False
+
+    # -- queries -------------------------------------------------------------
+    def rank(self) -> int:
+        return self.process_id
+
+    def size(self) -> int:
+        return self.num_processes
+
+    def local_rank(self) -> int:
+        # One process per host in the TPU model; if a launcher packs several
+        # processes per host it exports the reference env contract.
+        v = self.config.get(_config.LOCAL_RANK)
+        return v if v >= 0 else 0
+
+    def local_size(self) -> int:
+        v = self.config.get(_config.LOCAL_SIZE)
+        return v if v >= 0 else 1
+
+    def cross_rank(self) -> int:
+        v = self.config.get(_config.CROSS_RANK)
+        return v if v >= 0 else self.process_id
+
+    def cross_size(self) -> int:
+        v = self.config.get(_config.CROSS_SIZE)
+        return v if v >= 0 else self.num_processes
+
+
+def _jax():
+    import jax
+    return jax
+
+
+def init(process_sets: Optional[Sequence[Sequence[int]]] = None,
+         coordinator_address: Optional[str] = None,
+         num_processes: Optional[int] = None,
+         process_id: Optional[int] = None,
+         config_overrides: Optional[dict] = None) -> None:
+    """Initialize horovod_tpu.
+
+    Single-process (the default on a TPU host, where all local chips are
+    addressable without any rendezvous): no arguments needed. Multi-process
+    (launched by ``horovodrun-tpu`` or manually): the coordinator address and
+    process identity come from arguments or the env contract
+    (HVD_TPU_COORDINATOR_ADDR / HVD_TPU_RANK / HVD_TPU_SIZE — same shape as
+    the reference's HOROVOD_GLOO_RENDEZVOUS_ADDR / HOROVOD_RANK / HOROVOD_SIZE
+    contract, gloo/gloo_context.cc:142-165).
+
+    ``process_sets``: optional list of process-index lists, the analogue of
+    the reference's ``hvd.init(comm=ranks)`` subset communicators
+    (basics.py:33-65). Retrieve with :func:`process_set_mesh`.
+    """
+    global _world
+    with _lock:
+        if _world is not None:
+            return
+        cfg = _config.Config(config_overrides)
+        w = World(cfg)
+
+        addr = coordinator_address or cfg.get(_config.COORDINATOR_ADDR) or None
+        n = num_processes if num_processes is not None else cfg.get(_config.SIZE)
+        pid = process_id if process_id is not None else cfg.get(_config.RANK)
+
+        jax = _jax()
+        if addr and n and n > 1:
+            jax.distributed.initialize(
+                coordinator_address=addr,
+                num_processes=n,
+                process_id=pid,
+                initialization_timeout=int(
+                    cfg.get(_config.INIT_TIMEOUT_SECONDS)),
+            )
+            w.coordinator_addr = addr
+        w.process_id = jax.process_index()
+        w.num_processes = jax.process_count()
+
+        from .mesh import WorldMesh
+        w.world_mesh = WorldMesh()
+
+        if process_sets:
+            for i, ranks in enumerate(process_sets):
+                w.process_sets[i] = w.world_mesh.subset(list(ranks))
+
+        from .timeline import maybe_start_timeline
+        w.timeline = maybe_start_timeline(w)
+        from .stall import StallInspector
+        w.stall_inspector = StallInspector(w)
+
+        _world = w
+        atexit.register(_shutdown_quietly)
+
+
+def _shutdown_quietly():
+    try:
+        shutdown()
+    except Exception:
+        pass
+
+
+def shutdown() -> None:
+    """Tear down world state (reference: horovod_shutdown,
+    operations.cc:690-700). Safe to call twice; after shutdown, init() may be
+    called again (elastic reset does exactly this,
+    reference torch/elastic.py:46-49)."""
+    global _world
+    with _lock:
+        w = _world
+        if w is None:
+            return
+        w.shutdown_requested = True
+        if w.coordinator is not None:
+            w.coordinator.stop()
+        if w.timeline is not None:
+            w.timeline.close()
+        if w.stall_inspector is not None:
+            w.stall_inspector.stop()
+        if w.coordinator_addr:
+            try:
+                _jax().distributed.shutdown()
+            except Exception:
+                pass
+        _world = None
+
+
+def world() -> World:
+    if _world is None:
+        raise NotInitializedError()
+    return _world
+
+
+def is_initialized() -> bool:
+    return _world is not None
+
+
+def rank() -> int:
+    return world().rank()
+
+
+def size() -> int:
+    return world().size()
+
+
+def local_rank() -> int:
+    return world().local_rank()
+
+
+def local_size() -> int:
+    return world().local_size()
+
+
+def cross_rank() -> int:
+    return world().cross_rank()
+
+
+def cross_size() -> int:
+    return world().cross_size()
+
+
+def device_count() -> int:
+    world()
+    return _jax().device_count()
+
+
+def local_device_count() -> int:
+    world()
+    return _jax().local_device_count()
+
+
+def dp_size() -> int:
+    """Device-granular world size: the number the reference calls hvd.size()
+    in its one-process-per-GPU model. Use for LR scaling of data-parallel
+    compiled training."""
+    world()
+    return _jax().device_count()
+
+
+def is_homogeneous() -> bool:
+    """True when every process has the same number of local devices
+    (reference: mpi_controller.cc:25-81 homogeneity check)."""
+    w = world()
+    jax = _jax()
+    counts = {}
+    for d in jax.devices():
+        counts[d.process_index] = counts.get(d.process_index, 0) + 1
+    return len(set(counts.values())) <= 1
+
+
+def process_set_mesh(i: int):
+    """The WorldMesh for process set ``i`` registered at init()."""
+    return world().process_sets[i]
+
+
+def hostname() -> str:
+    w = world()
+    return w.config.get(_config.HOSTNAME) or socket.gethostname()
+
+
+# -- capability queries (reference: mpi_built/gloo_built/nccl_built/...,
+#    basics.py:140-215). On TPU the data plane is always XLA. -----------------
+def xla_built() -> bool:
+    return True
+
+
+def tpu_available() -> bool:
+    try:
+        return any(d.platform == "tpu" for d in _jax().devices())
+    except Exception:
+        return False
+
+
+def mpi_built() -> bool:
+    return False
+
+
+def mpi_enabled() -> bool:
+    return False
+
+
+def gloo_built() -> bool:
+    return False
+
+
+def nccl_built() -> bool:
+    return False
+
+
+def ccl_built() -> bool:
+    return False
+
+
+def ddl_built() -> bool:
+    return False
+
+
+def cuda_built() -> bool:
+    return False
+
+
+def rocm_built() -> bool:
+    return False
+
+
+def mpi_threads_supported() -> bool:
+    return False

@@ -1,0 +1,674 @@
+"""Eager (host-plane) collectives for horovod_tpu.
+
+The reference's data plane enqueues tensors to a background C++ thread that
+negotiates readiness and calls NCCL/MPI/Gloo
+(/root/reference/horovod/common/operations.cc:815-966 Enqueue*,
+ops/nccl_operations.cc:125-175). On TPU the data plane is XLA: an eager
+collective is a tiny jitted SPMD program over the ``'proc'`` axis of the
+:class:`~horovod_tpu.mesh.WorldMesh` — each process contributes its local
+value as one shard of a global array, XLA lowers the reduction to ICI/DCN
+collectives, and the replicated result is read back locally. JAX's async
+dispatch replaces the reference's handle/finalizer-thread pipelining
+(gpu_operations.cc:60-87): ``*_async`` returns immediately with a handle and
+``synchronize`` blocks on the device future.
+
+Semantics parity with the reference API
+(horovod/torch/mpi_ops.py, horovod/tensorflow/mpi_ops.py):
+
+* ``allreduce(tensor, average/op, prescale_factor, postscale_factor, name)``
+* ``allgather(tensor, name)`` — concat along dim 0, ragged first dims allowed
+  (collective_operations.cc:87-194 allgatherv displacement math)
+* ``broadcast(tensor, root_rank, name)``
+* ``alltoall(tensor, splits, name)``
+* ``grouped_allreduce([tensors], ...)`` — one fused dispatch
+* duplicate in-flight names raise (tensor_queue.cc DUPLICATE_NAME_ERROR)
+* with ``HVD_TPU_CHECK_CONSISTENCY=1``, mismatched shape/dtype/op across
+  processes raise instead of deadlock (controller.cc:378-611 validation)
+
+Ops beyond a single process require ``init()`` with a multi-process world;
+with one process they are exact local equivalents (size-1 semantics, as the
+reference's tests use when run without a launcher).
+"""
+
+import enum
+import threading
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+from . import basics as _basics
+from . import config as _config
+from . import timeline as _tl
+from .exceptions import HorovodInternalError, TensorValidationError
+from .tensor_table import Handle, TensorTable, metadata_fingerprint
+
+
+class ReduceOp(enum.Enum):
+    """Reduction ops (reference: Average/Sum/Adasum in
+    horovod/torch/mpi_ops.py:40-44; Min/Max/Product added for completeness)."""
+    AVERAGE = "average"
+    SUM = "sum"
+    ADASUM = "adasum"
+    MIN = "min"
+    MAX = "max"
+    PRODUCT = "product"
+
+
+Average = ReduceOp.AVERAGE
+Sum = ReduceOp.SUM
+Adasum = ReduceOp.ADASUM
+Min = ReduceOp.MIN
+Max = ReduceOp.MAX
+Product = ReduceOp.PRODUCT
+
+_name_lock = threading.Lock()
+_name_counter = 0
+
+
+def _auto_name(kind: str) -> str:
+    global _name_counter
+    with _name_lock:
+        _name_counter += 1
+        return f"{kind}.noname.{_name_counter}"
+
+
+def _world():
+    return _basics.world()
+
+
+def _table(w) -> TensorTable:
+    if getattr(w, "_tensor_table", None) is None:
+        w._tensor_table = TensorTable(w)
+    return w._tensor_table
+
+
+def _jnp():
+    import jax.numpy as jnp
+    return jnp
+
+
+def _jax():
+    import jax
+    return jax
+
+
+# ---------------------------------------------------------------------------
+# Jitted SPMD programs over the world mesh, cached per (world, signature).
+# This cache is the TPU-shaped descendant of the reference ResponseCache
+# (response_cache.{h,cc}): steady-state calls skip all planning.
+# ---------------------------------------------------------------------------
+
+def _jit_cache(w) -> dict:
+    if getattr(w, "_collective_jit_cache", None) is None:
+        w._collective_jit_cache = {}
+    return w._collective_jit_cache
+
+
+def _get_program(w, key, builder):
+    cache = _jit_cache(w)
+    fn = cache.get(key)
+    if fn is None:
+        fn = builder()
+        cache[key] = fn
+    return fn
+
+
+def _global_from_local(wm, local_np, extra_leading=True):
+    """Stack this process's value as its row of a (nproc, ...) global array."""
+    jax = _jax()
+    shape = (wm.num_procs,) + tuple(local_np.shape)
+    shard = jax.device_put(
+        local_np[None] if extra_leading else local_np, wm.anchor_device)
+    return jax.make_array_from_single_device_arrays(
+        shape, wm.stacked_sharding(), [shard])
+
+
+def _local_result(out):
+    """Read back this process's replica of a replicated jit output."""
+    return out.addressable_data(0)
+
+
+def _check_consistency(w, wm, name, shape, dtype, kind, extra=""):
+    """Cross-process metadata validation (controller.cc:378-611 analogue).
+
+    Allgathers a 32-bit fingerprint of (name, shape, dtype, op) across
+    processes and raises listing mismatching processes. Only runs when
+    HVD_TPU_CHECK_CONSISTENCY is enabled and the world is multi-process.
+    """
+    if wm.num_procs <= 1:
+        return
+    if not w.config.get(_config.CHECK_CONSISTENCY):
+        return
+    fp = metadata_fingerprint(name, shape, dtype, kind, extra)
+    garr = _global_from_local(wm, np.array([fp], dtype=np.uint32))
+
+    def build():
+        return _jax().jit(
+            lambda a: a, out_shardings=wm.replicated_sharding())
+    fn = _get_program(w, ("consistency", wm.cache_key), build)
+    fps = np.asarray(_local_result(fn(garr))).reshape(-1)
+    if len(set(int(x) for x in fps)) > 1:
+        mine = int(fps[wm.my_index])
+        bad = [i for i, x in enumerate(fps) if int(x) != mine]
+        raise TensorValidationError(
+            f"Mismatched metadata for collective {name!r} ({kind}): "
+            f"processes {bad} submitted a different shape/dtype/op than "
+            f"process {wm.my_index}. All processes must submit "
+            f"identical requests for the same tensor name.")
+
+
+def _combined_scale(op: ReduceOp, nproc: int, prescale: float,
+                    postscale: float, dtype) -> float:
+    if op in (ReduceOp.MIN, ReduceOp.MAX, ReduceOp.PRODUCT) and (
+            prescale != 1.0 or postscale != 1.0):
+        raise ValueError(
+            "prescale_factor/postscale_factor are only supported for "
+            "Sum/Average/Adasum (reference semantics).")
+    scale = prescale * postscale
+    if op == ReduceOp.AVERAGE:
+        scale /= nproc
+    if scale != 1.0 and np.issubdtype(np.dtype(dtype), np.integer):
+        raise ValueError(
+            "prescale/postscale/average on integer tensors is not supported; "
+            "use op=horovod_tpu.Sum for integer dtypes.")
+    return scale
+
+
+# ---------------------------------------------------------------------------
+# allreduce
+# ---------------------------------------------------------------------------
+
+def _allreduce_impl(w, values, op, prescale_factor, postscale_factor,
+                    process_set=None, internal=False):
+    """Fused allreduce of a list of same-dtype-or-mixed tensors. Returns the
+    list of reduced jax arrays. One jit dispatch per call (grouped tensors
+    share it — the fusion-buffer behavior of collective_operations.cc:37-81,
+    done by XLA fusion instead of explicit memcpy staging)."""
+    jnp = _jnp()
+    jax = _jax()
+    wm = process_set or w.world_mesh
+    nproc = wm.num_procs
+
+    if w.joined and not internal:
+        # After join(), this process contributes zeros to every further
+        # reduction (reference: GetTensorEntriesFromResponse substitutes zero
+        # tensors for joined ranks, tensor_queue.cc).
+        values = [np.zeros_like(np.asarray(v)) for v in values]
+
+    if op == ReduceOp.ADASUM:
+        from .adasum import adasum_eager
+        return adasum_eager(w, values, wm, prescale_factor, postscale_factor)
+
+    scales = [
+        _combined_scale(op, nproc, prescale_factor, postscale_factor, v.dtype)
+        for v in values]
+
+    if nproc == 1:
+        sig = ("allreduce1", tuple((tuple(v.shape), str(v.dtype)) for v in values),
+               tuple(scales), op.value)
+
+        def build1():
+            def f(*vs):
+                # non-unit scales on integer dtypes already rejected above
+                return tuple(
+                    v if s == 1.0 else (v * s).astype(v.dtype)
+                    for v, s in zip(vs, scales))
+            return jax.jit(f)
+        fn = _get_program(w, sig, build1)
+        return list(fn(*values))
+
+    reducer = {
+        ReduceOp.AVERAGE: jnp.sum, ReduceOp.SUM: jnp.sum,
+        ReduceOp.MIN: jnp.min, ReduceOp.MAX: jnp.max,
+        ReduceOp.PRODUCT: jnp.prod,
+    }[op]
+
+    sig = ("allreduce", nproc, wm.cache_key,
+           tuple((tuple(v.shape), str(v.dtype)) for v in values),
+           tuple(scales), op.value)
+
+    def build():
+        def f(*stacked):
+            out = []
+            for g, s in zip(stacked, scales):
+                dtype = g.dtype
+                acc = g
+                if dtype == jnp.bfloat16 or dtype == jnp.float16:
+                    acc = g.astype(jnp.float32)  # accumulate halfs in fp32
+                r = reducer(acc, axis=0)
+                if s != 1.0:
+                    r = r * s
+                out.append(r.astype(dtype))
+            return tuple(out)
+        return jax.jit(f, out_shardings=wm.replicated_sharding())
+    fn = _get_program(w, sig, build)
+
+    globals_ = [_global_from_local(wm, np.asarray(v)) for v in values]
+    outs = fn(*globals_)
+    if not isinstance(outs, tuple):
+        outs = (outs,)
+    return [_local_result(o) for o in outs]
+
+
+def allreduce(tensor, average=None, name: Optional[str] = None,
+              op: Optional[ReduceOp] = None, prescale_factor: float = 1.0,
+              postscale_factor: float = 1.0, process_set=None):
+    """Synchronous allreduce (reference: torch/mpi_ops.py:158-200,
+    tensorflow/__init__.py:52-131). ``average`` is the legacy boolean knob;
+    ``op`` takes precedence."""
+    h = allreduce_async(tensor, average=average, name=name, op=op,
+                        prescale_factor=prescale_factor,
+                        postscale_factor=postscale_factor,
+                        process_set=process_set)
+    return synchronize(h)
+
+
+def allreduce_async(tensor, average=None, name: Optional[str] = None,
+                    op: Optional[ReduceOp] = None,
+                    prescale_factor: float = 1.0,
+                    postscale_factor: float = 1.0, process_set=None) -> int:
+    op = _resolve_op(average, op)
+    w = _world()
+    name = name or _auto_name("allreduce")
+    h = _table(w).begin(name, "allreduce")
+    tl = w.timeline
+    tl.start(name, "allreduce")
+    try:
+        wm = process_set or w.world_mesh
+        _check_consistency(w, wm, name, np.shape(tensor),
+                           np.asarray(tensor).dtype, "allreduce", op.value)
+        tl.activity_start(name, _tl.XLA_ALLREDUCE)
+        (out,) = _allreduce_impl(w, [tensor], op, prescale_factor,
+                                 postscale_factor, process_set)
+        tl.activity_end(name)
+        h.result = out
+    except Exception as e:
+        h.error = _wrap_error(e)
+        _finish(w, h)
+        raise h.error from e
+    return _register_async(w, h)
+
+
+def grouped_allreduce(tensors: Sequence, average=None,
+                      name: Optional[str] = None, op: Optional[ReduceOp] = None,
+                      prescale_factor: float = 1.0,
+                      postscale_factor: float = 1.0,
+                      process_set=None) -> List:
+    """Fused allreduce of several tensors in one dispatch (reference:
+    grouped_allreduce, torch/mpi_ops.py:202-260; fusion behavior of
+    EnqueueTensorAllreduces)."""
+    op = _resolve_op(average, op)
+    w = _world()
+    base = name or _auto_name("grouped_allreduce")
+    names = [f"{base}.{i}" for i in range(len(tensors))]
+    hs = [_table(w).begin(n, "grouped_allreduce") for n in names]
+    try:
+        outs = _allreduce_impl(w, list(tensors), op, prescale_factor,
+                               postscale_factor, process_set)
+    except Exception as e:
+        err = _wrap_error(e)
+        for h in hs:
+            h.error = err
+            _finish(w, h)
+        raise err from e
+    for h, o in zip(hs, outs):
+        h.result = o
+        _finish(w, h)
+    return outs
+
+
+# ---------------------------------------------------------------------------
+# allgather
+# ---------------------------------------------------------------------------
+
+def allgather(tensor, name: Optional[str] = None, process_set=None):
+    """Concatenate each process's tensor along dim 0 (reference:
+    torch/mpi_ops.py:310-343). First dims may differ across processes; other
+    dims must match (collective_operations.cc:87-194)."""
+    h = allgather_async(tensor, name=name, process_set=process_set)
+    return synchronize(h)
+
+
+def allgather_async(tensor, name: Optional[str] = None, process_set=None) -> int:
+    w = _world()
+    jax, jnp = _jax(), _jnp()
+    name = name or _auto_name("allgather")
+    h = _table(w).begin(name, "allgather")
+    tl = w.timeline
+    tl.start(name, "allgather")
+    try:
+        wm = process_set or w.world_mesh
+        nproc = wm.num_procs
+        local = np.asarray(tensor)
+        # only non-first dims must match across processes
+        _check_consistency(w, wm, name, local.shape[1:], local.dtype,
+                           "allgather")
+        if nproc == 1:
+            h.result = jnp.asarray(local)
+        else:
+            tl.activity_start(name, _tl.XLA_ALLGATHER)
+            # 1) exchange first-dim sizes (the reference's negotiation of
+            #    per-rank sizes before allocating the allgatherv output)
+            sizes = _exchange_sizes(w, wm, local.shape[0] if local.ndim else 1)
+            dim0 = local.shape[0] if local.ndim else 1
+            maxd = int(sizes.max())
+            if all(int(s) == dim0 for s in sizes):
+                # uniform fast path: global array IS the gathered result
+                shape = (nproc * dim0,) + local.shape[1:]
+                shard = jax.device_put(local, wm.anchor_device)
+                garr = jax.make_array_from_single_device_arrays(
+                    shape, wm.stacked_sharding(), [shard])
+
+                def build():
+                    return jax.jit(lambda a: a,
+                                   out_shardings=wm.replicated_sharding())
+                fn = _get_program(
+                    w, ("allgather_uniform", nproc, wm.cache_key,
+                        shape, str(local.dtype)), build)
+                h.result = _local_result(fn(garr))
+            else:
+                # ragged: pad to max, gather, slice+concat with static sizes
+                pad = maxd - dim0
+                padded = np.pad(local, [(0, pad)] + [(0, 0)] * (local.ndim - 1))
+                garr = _global_from_local(wm, padded)
+                sizes_t = tuple(int(s) for s in sizes)
+
+                def build():
+                    def f(a):
+                        parts = [a[i, :sizes_t[i]] for i in range(nproc)]
+                        return jnp.concatenate(parts, axis=0)
+                    return jax.jit(f, out_shardings=wm.replicated_sharding())
+                fn = _get_program(
+                    w, ("allgather_ragged", nproc, wm.cache_key, sizes_t,
+                        padded.shape, str(local.dtype)), build)
+                h.result = _local_result(fn(garr))
+            tl.activity_end(name)
+    except Exception as e:
+        h.error = _wrap_error(e)
+        _finish(w, h)
+        raise h.error from e
+    return _register_async(w, h)
+
+
+def _exchange_sizes(w, wm, my_dim0: int) -> np.ndarray:
+    jax = _jax()
+    garr = _global_from_local(wm, np.array([my_dim0], dtype=np.int32))
+
+    def build():
+        return jax.jit(lambda a: a, out_shardings=wm.replicated_sharding())
+    fn = _get_program(w, ("sizes", wm.num_procs, wm.cache_key), build)
+    return np.asarray(_local_result(fn(garr))).reshape(-1)
+
+
+# ---------------------------------------------------------------------------
+# broadcast
+# ---------------------------------------------------------------------------
+
+def broadcast(tensor, root_rank: int, name: Optional[str] = None,
+              process_set=None):
+    """Every process receives root's value (reference:
+    torch/mpi_ops.py:345-389). Shapes/dtypes must match on all processes
+    (controller.cc validation)."""
+    h = broadcast_async(tensor, root_rank, name=name, process_set=process_set)
+    return synchronize(h)
+
+
+def broadcast_async(tensor, root_rank: int, name: Optional[str] = None,
+                    process_set=None) -> int:
+    w = _world()
+    jax, jnp = _jax(), _jnp()
+    name = name or _auto_name("broadcast")
+    h = _table(w).begin(name, "broadcast")
+    tl = w.timeline
+    tl.start(name, "broadcast")
+    try:
+        wm = process_set or w.world_mesh
+        nproc = wm.num_procs
+        local = np.asarray(tensor)
+        _check_consistency(w, wm, name, local.shape, local.dtype,
+                           "broadcast", str(root_rank))
+        if not (0 <= root_rank < nproc):
+            raise ValueError(f"root_rank {root_rank} out of range for world "
+                             f"size {nproc}")
+        if nproc == 1:
+            h.result = jnp.asarray(local)
+        else:
+            tl.activity_start(name, _tl.XLA_BROADCAST)
+            garr = _global_from_local(wm, local)
+
+            def build():
+                return jax.jit(lambda a: a[root_rank],
+                               out_shardings=wm.replicated_sharding())
+            fn = _get_program(
+                w, ("broadcast", nproc, wm.cache_key, root_rank,
+                    local.shape, str(local.dtype)), build)
+            h.result = _local_result(fn(garr))
+            tl.activity_end(name)
+    except Exception as e:
+        h.error = _wrap_error(e)
+        _finish(w, h)
+        raise h.error from e
+    return _register_async(w, h)
+
+
+# ---------------------------------------------------------------------------
+# alltoall
+# ---------------------------------------------------------------------------
+
+def alltoall(tensor, splits=None, name: Optional[str] = None, process_set=None):
+    """Scatter slices of ``tensor`` to every process and gather received
+    slices, concatenated along dim 0. ``splits`` (optional, len = world size)
+    gives per-destination row counts; default is an even split."""
+    w = _world()
+    jax, jnp = _jax(), _jnp()
+    name = name or _auto_name("alltoall")
+    h = _table(w).begin(name, "alltoall")
+    tl = w.timeline
+    tl.start(name, "alltoall")
+    try:
+        wm = process_set or w.world_mesh
+        nproc = wm.num_procs
+        local = np.asarray(tensor)
+        _check_consistency(w, wm, name, local.shape[1:], local.dtype,
+                           "alltoall")
+        if splits is None:
+            if local.shape[0] % nproc != 0:
+                raise ValueError(
+                    f"alltoall tensor first dim {local.shape[0]} not divisible"
+                    f" by world size {nproc}; pass explicit splits")
+            splits = [local.shape[0] // nproc] * nproc
+        splits = [int(s) for s in splits]
+        if len(splits) != nproc or sum(splits) != local.shape[0]:
+            raise ValueError("splits must have one entry per process and sum "
+                             "to the tensor's first dimension")
+        if nproc == 1:
+            h.result = jnp.asarray(local)
+        else:
+            tl.activity_start(name, _tl.XLA_ALLTOALL)
+            # exchange split tables so each process knows incoming sizes
+            split_tbl = _exchange_split_table(w, wm, splits)
+            maxs = int(split_tbl.max())
+            # pad each outgoing chunk to maxs rows: (nproc, maxs, rest)
+            rest = local.shape[1:]
+            chunks = np.zeros((nproc, maxs) + rest, dtype=local.dtype)
+            off = 0
+            for j, s in enumerate(splits):
+                chunks[j, :s] = local[off:off + s]
+                off += s
+            garr = _global_from_local(wm, chunks)  # (src, dst, maxs, *rest)
+
+            # NOTE: the jitted exchange must be IDENTICAL on every process
+            # (one SPMD program); per-process unpacking happens locally below.
+            def build():
+                return jax.jit(lambda a: jnp.swapaxes(a, 0, 1),
+                               out_shardings=wm.stacked_sharding())
+            fn = _get_program(
+                w, ("alltoall", nproc, wm.cache_key, chunks.shape,
+                    str(local.dtype)), build)
+            # my shard: (1, src, maxs, *rest) — rows every src sent to me
+            mine = np.asarray(_local_result(fn(garr)))[0]
+            incoming = [int(split_tbl[src, wm.my_index])
+                        for src in range(nproc)]
+            h.result = jnp.concatenate(
+                [jnp.asarray(mine[s, :incoming[s]]) for s in range(nproc)],
+                axis=0)
+            tl.activity_end(name)
+    except Exception as e:
+        h.error = _wrap_error(e)
+        _finish(w, h)
+        raise h.error from e
+    hid = _register_async(w, h)
+    return synchronize(hid)
+
+
+def _exchange_split_table(w, wm, splits) -> np.ndarray:
+    jax = _jax()
+    garr = _global_from_local(wm, np.array(splits, dtype=np.int32))
+
+    def build():
+        return jax.jit(lambda a: a, out_shardings=wm.replicated_sharding())
+    fn = _get_program(
+        w, ("split_table", wm.num_procs, wm.cache_key), build)
+    return np.asarray(_local_result(fn(garr))).reshape(wm.num_procs, -1)
+
+
+# ---------------------------------------------------------------------------
+# handles (reference: torch/mpi_ops.py poll/synchronize/join semantics)
+# ---------------------------------------------------------------------------
+
+def _register_async(w, h: Handle) -> int:
+    return h.id
+
+
+def _finish(w, h: Handle):
+    _table(w).finish(h)
+
+
+def _wrap_error(e: BaseException) -> BaseException:
+    if isinstance(e, (TensorValidationError, ValueError, TypeError)):
+        return e
+    return HorovodInternalError(str(e))
+
+
+def poll(handle: int) -> bool:
+    """True when the collective backing ``handle`` has completed on device
+    (reference: torch/mpi_ops.py:476-485)."""
+    w = _world()
+    h = _table(w).get(handle)
+    if h.error is not None:
+        return True
+    r = h.result
+    if r is None:
+        return True
+    is_ready = getattr(r, "is_ready", None)
+    return bool(is_ready()) if callable(is_ready) else True
+
+
+def synchronize(handle: int):
+    """Block until the collective completes; return its result
+    (reference: torch/mpi_ops.py:487-499). The wait is interruptible by the
+    stall inspector's shutdown deadline (stall_inspector.h:80 semantics):
+    rather than blocking unconditionally, poll device readiness and re-check
+    the deadline between polls."""
+    import time as _time
+    w = _world()
+    h = _table(w).get(handle)
+    try:
+        if h.error is not None:
+            raise h.error
+        r = h.result
+        if r is not None:
+            insp = w.stall_inspector
+            is_ready = getattr(r, "is_ready", None)
+            if insp is not None and callable(is_ready):
+                while not is_ready():
+                    insp.check_shutdown()
+                    _time.sleep(0.002)
+            _jax().block_until_ready(r)
+        return h.result
+    finally:
+        _finish(w, h)
+
+
+def join(device: int = -1) -> int:
+    """Signal that this process has exhausted its data (reference Join op,
+    operations.cc:942-966, controller.cc:219-273: remaining collectives see
+    zero contributions from joined ranks).
+
+    Departure from the reference, documented: the reference's background
+    thread keeps a joined rank participating in negotiation one-sidedly. In
+    the compiled SPMD eager plane there is no background negotiation, so Join
+    is cooperative: after ``join()`` this process contributes zeros to every
+    subsequent reduction but must keep driving its training loop's
+    collectives until all processes have joined (the
+    :mod:`horovod_tpu.optimizer` wrappers do this). ``join()`` itself is a
+    collective; it returns the rank that joined last, determined by
+    exchanging per-process join timestamps."""
+    import time as _time
+    w = _world()
+    w.joined = True
+    # exchange (timestamp, rank); argmax timestamp = last to join
+    stamp = np.array([_time.time()], dtype=np.float64)
+    stamps = np.asarray(allgather(stamp, name="horovod_tpu.join.ts"))
+    return int(np.argmax(stamps))
+
+
+def joined() -> bool:
+    return _world().joined
+
+
+def barrier():
+    """Host barrier across processes (reference: controller Barrier)."""
+    allreduce(np.zeros((1,), np.float32), op=Sum, name="horovod_tpu.barrier")
+
+
+def _resolve_op(average, op) -> ReduceOp:
+    if average is not None and op is not None:
+        raise ValueError("Set either average or op; not both "
+                         "(reference semantics: util.py "
+                         "get_average_backwards_compatibility_fun).")
+    if op is None:
+        if average is None:
+            return ReduceOp.AVERAGE
+        return ReduceOp.AVERAGE if average else ReduceOp.SUM
+    if not isinstance(op, ReduceOp):
+        raise TypeError(f"op must be a horovod_tpu.ReduceOp, got {op!r}")
+    return op
+
+
+# ---------------------------------------------------------------------------
+# in-jit collectives: thin named wrappers for use inside shard_map/pjit.
+# These are what compiled training steps call; XLA lowers them onto ICI.
+# ---------------------------------------------------------------------------
+
+def psum(x, axis_name: str):
+    import jax
+    return jax.lax.psum(x, axis_name)
+
+
+def pmean(x, axis_name: str):
+    import jax
+    return jax.lax.pmean(x, axis_name)
+
+
+def all_gather_in_jit(x, axis_name: str, axis: int = 0, tiled: bool = True):
+    import jax
+    return jax.lax.all_gather(x, axis_name, axis=axis, tiled=tiled)
+
+
+def reduce_scatter_in_jit(x, axis_name: str, scatter_dimension: int = 0):
+    import jax
+    return jax.lax.psum_scatter(
+        x, axis_name, scatter_dimension=scatter_dimension, tiled=True)
+
+
+def all_to_all_in_jit(x, axis_name: str, split_axis: int, concat_axis: int):
+    import jax
+    return jax.lax.all_to_all(
+        x, axis_name, split_axis=split_axis, concat_axis=concat_axis,
+        tiled=True)
+
+
+def ppermute(x, axis_name: str, perm):
+    import jax
+    return jax.lax.ppermute(x, axis_name, perm)

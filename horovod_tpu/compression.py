@@ -1,0 +1,79 @@
+"""Gradient compression (reference: horovod/torch/compression.py,
+horovod/tensorflow/compression.py — NoneCompressor / FP16Compressor).
+
+On TPU the natural half precision is bfloat16 (same exponent range as fp32,
+MXU-native), so ``Compression.fp16`` here maps to bfloat16 by default with an
+``fp16`` literal variant for exact reference parity. The eager allreduce
+accumulates half-precision inputs in fp32 (collectives.py), matching the
+reference's fp16 sum correctness concern (common/half.{h,cc}).
+"""
+
+
+class Compressor:
+    """Interface: compress(tensor) -> (compressed, ctx);
+    decompress(compressed, ctx) -> tensor."""
+
+    @staticmethod
+    def compress(tensor):
+        raise NotImplementedError
+
+    @staticmethod
+    def decompress(tensor, ctx):
+        raise NotImplementedError
+
+
+class NoneCompressor(Compressor):
+    @staticmethod
+    def compress(tensor):
+        return tensor, None
+
+    @staticmethod
+    def decompress(tensor, ctx):
+        return tensor
+
+
+class _HalfCompressor(Compressor):
+    target = None  # set in subclasses
+
+    @classmethod
+    def compress(cls, tensor):
+        import jax.numpy as jnp
+        t = jnp.asarray(tensor)
+        ctx = t.dtype
+        if jnp.issubdtype(t.dtype, jnp.floating):
+            t = t.astype(cls.target)
+        return t, ctx
+
+    @classmethod
+    def decompress(cls, tensor, ctx):
+        import jax.numpy as jnp
+        t = jnp.asarray(tensor)
+        if ctx is not None and t.dtype != ctx:
+            t = t.astype(ctx)
+        return t
+
+
+class BF16Compressor(_HalfCompressor):
+    """Compress float gradients to bfloat16 for the wire (TPU-native half)."""
+
+
+class FP16Compressor(_HalfCompressor):
+    """Compress float gradients to float16 (exact reference parity)."""
+
+
+def _bind_targets():
+    import jax.numpy as jnp
+    BF16Compressor.target = jnp.bfloat16
+    FP16Compressor.target = jnp.float16
+
+
+class Compression:
+    """Optional gradient compression algorithms (reference API:
+    hvd.Compression.none / hvd.Compression.fp16)."""
+    none = NoneCompressor
+    fp16 = BF16Compressor       # TPU-native half: bfloat16
+    fp16_strict = FP16Compressor  # literal IEEE fp16
+    bf16 = BF16Compressor
+
+
+_bind_targets()

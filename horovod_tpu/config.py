@@ -1,0 +1,166 @@
+"""Environment-knob registry for horovod_tpu.
+
+The reference drives its C++ core with ~40 ``HOROVOD_*`` environment variables
+(/root/reference/horovod/common/common.h:61-88, parsed in
+common/operations.cc:338-504 and common/utils/env_parser.cc). horovod_tpu keeps
+the same three-layer contract (env vars <- CLI flags <- YAML config, see
+runner/config_parser.py) with a typed registry so every knob is declared in
+exactly one place.
+
+Knobs use the ``HVD_TPU_`` prefix; for knobs that have a direct reference
+equivalent the corresponding ``HOROVOD_*`` name is accepted as an alias so
+existing run scripts keep working.
+"""
+
+import dataclasses
+import os
+from typing import Any, Callable, Dict, Optional
+
+
+def _parse_bool(v: str) -> bool:
+    return v.strip().lower() in ("1", "true", "yes", "on")
+
+
+@dataclasses.dataclass
+class Knob:
+    name: str                       # HVD_TPU_<NAME>
+    default: Any
+    parser: Callable[[str], Any]
+    alias: Optional[str] = None     # HOROVOD_* compatibility alias
+    help: str = ""
+
+
+_REGISTRY: Dict[str, Knob] = {}
+
+
+def _register(name, default, parser, alias=None, help=""):
+    _REGISTRY[name] = Knob(name, default, parser, alias, help)
+    return name
+
+
+# -- Fusion / cycle (reference: HOROVOD_FUSION_THRESHOLD, HOROVOD_CYCLE_TIME,
+#    common.h:64-65, defaults operations.cc:417-504: 64MB / 5ms) --------------
+FUSION_THRESHOLD = _register(
+    "FUSION_THRESHOLD", 64 * 1024 * 1024, int, alias="HOROVOD_FUSION_THRESHOLD",
+    help="Gradient-bucket fusion threshold in bytes (0 disables fusion).")
+CYCLE_TIME = _register(
+    "CYCLE_TIME", 1.0, float, alias="HOROVOD_CYCLE_TIME",
+    help="Async-coordinator cycle time in milliseconds.")
+CACHE_CAPACITY = _register(
+    "CACHE_CAPACITY", 1024, int, alias="HOROVOD_CACHE_CAPACITY",
+    help="Capacity of the fused-collective plan cache (0 disables).")
+
+# -- Logging / timeline (reference: HOROVOD_LOG_LEVEL, HOROVOD_TIMELINE,
+#    HOROVOD_TIMELINE_MARK_CYCLES, common.h:61-63) ---------------------------
+LOG_LEVEL = _register(
+    "LOG_LEVEL", "warning", str, alias="HOROVOD_LOG_LEVEL",
+    help="trace/debug/info/warning/error/fatal.")
+LOG_HIDE_TIME = _register(
+    "LOG_HIDE_TIME", False, _parse_bool, alias="HOROVOD_LOG_HIDE_TIME")
+TIMELINE = _register(
+    "TIMELINE", "", str, alias="HOROVOD_TIMELINE",
+    help="Path for chrome://tracing JSON timeline (rank 0 only).")
+TIMELINE_MARK_CYCLES = _register(
+    "TIMELINE_MARK_CYCLES", False, _parse_bool,
+    alias="HOROVOD_TIMELINE_MARK_CYCLES")
+
+# -- Stall inspector (reference: stall_inspector.h:75-80) --------------------
+STALL_CHECK_DISABLE = _register(
+    "STALL_CHECK_DISABLE", False, _parse_bool,
+    alias="HOROVOD_STALL_CHECK_DISABLE")
+STALL_CHECK_TIME_SECONDS = _register(
+    "STALL_CHECK_TIME_SECONDS", 60.0, float,
+    alias="HOROVOD_STALL_CHECK_TIME_SECONDS")
+STALL_SHUTDOWN_TIME_SECONDS = _register(
+    "STALL_SHUTDOWN_TIME_SECONDS", 0.0, float,
+    alias="HOROVOD_STALL_SHUTDOWN_TIME_SECONDS")
+
+# -- Autotune (reference: HOROVOD_AUTOTUNE*, parameter_manager.h:33-105) -----
+AUTOTUNE = _register(
+    "AUTOTUNE", False, _parse_bool, alias="HOROVOD_AUTOTUNE")
+AUTOTUNE_LOG = _register(
+    "AUTOTUNE_LOG", "", str, alias="HOROVOD_AUTOTUNE_LOG")
+AUTOTUNE_WARMUP_SAMPLES = _register(
+    "AUTOTUNE_WARMUP_SAMPLES", 3, int, alias="HOROVOD_AUTOTUNE_WARMUP_SAMPLES")
+AUTOTUNE_STEPS_PER_SAMPLE = _register(
+    "AUTOTUNE_STEPS_PER_SAMPLE", 10, int,
+    alias="HOROVOD_AUTOTUNE_STEPS_PER_SAMPLE")
+AUTOTUNE_BAYES_OPT_MAX_SAMPLES = _register(
+    "AUTOTUNE_BAYES_OPT_MAX_SAMPLES", 20, int,
+    alias="HOROVOD_AUTOTUNE_BAYES_OPT_MAX_SAMPLES")
+
+# -- Rendezvous / world (reference env contract HOROVOD_RANK/SIZE/...,
+#    gloo/gloo_context.cc:142-165, set by the launcher gloo_run.py:64-201) ---
+RANK = _register("RANK", -1, int, alias="HOROVOD_RANK")
+SIZE = _register("SIZE", -1, int, alias="HOROVOD_SIZE")
+LOCAL_RANK = _register("LOCAL_RANK", -1, int, alias="HOROVOD_LOCAL_RANK")
+LOCAL_SIZE = _register("LOCAL_SIZE", -1, int, alias="HOROVOD_LOCAL_SIZE")
+CROSS_RANK = _register("CROSS_RANK", -1, int, alias="HOROVOD_CROSS_RANK")
+CROSS_SIZE = _register("CROSS_SIZE", -1, int, alias="HOROVOD_CROSS_SIZE")
+HOSTNAME = _register("HOSTNAME", "", str, alias="HOROVOD_HOSTNAME")
+COORDINATOR_ADDR = _register(
+    "COORDINATOR_ADDR", "", str, alias="HOROVOD_GLOO_RENDEZVOUS_ADDR",
+    help="host:port of the JAX distributed coordinator / rendezvous server.")
+RENDEZVOUS_PORT = _register(
+    "RENDEZVOUS_PORT", -1, int, alias="HOROVOD_GLOO_RENDEZVOUS_PORT",
+    help="Port of the launcher's HTTP KV rendezvous server.")
+RENDEZVOUS_ADDR = _register(
+    "RENDEZVOUS_ADDR", "", str,
+    help="Host of the launcher's HTTP KV rendezvous server.")
+ELASTIC = _register("ELASTIC", False, _parse_bool, alias="HOROVOD_ELASTIC")
+INIT_TIMEOUT_SECONDS = _register(
+    "INIT_TIMEOUT_SECONDS", 300.0, float,
+    alias="HOROVOD_GLOO_TIMEOUT_SECONDS",
+    help="Timeout for distributed initialization / re-rendezvous.")
+
+# -- Consistency checking (replaces the reference controller's per-cycle
+#    dtype/shape validation, controller.cc:378-611) --------------------------
+CHECK_CONSISTENCY = _register(
+    "CHECK_CONSISTENCY", False, _parse_bool,
+    help="Cross-process validation of name/shape/dtype for eager collectives.")
+
+# -- Misc -------------------------------------------------------------------
+NUM_STREAMS = _register(
+    "NUM_STREAMS", 1, int, alias="HOROVOD_NUM_NCCL_STREAMS",
+    help="Number of round-robin dispatch lanes for fused collectives.")
+BATCH_D2D_MEMCOPIES = _register(
+    "BATCH_D2D_MEMCOPIES", True, _parse_bool,
+    alias="HOROVOD_BATCH_D2D_MEMCOPIES")
+ADASUM_MODE = _register(
+    "ADASUM_MODE", "auto", str,
+    help="Adasum hierarchy: auto|flat|hierarchical.")
+
+
+class Config:
+    """Resolves knob values: programmatic override > env(HVD_TPU_) > env(alias)
+    > default. One instance lives on the global world state."""
+
+    def __init__(self, overrides: Optional[Dict[str, Any]] = None):
+        self._overrides: Dict[str, Any] = dict(overrides or {})
+
+    def set(self, name: str, value: Any) -> None:
+        if name not in _REGISTRY:
+            raise KeyError(f"unknown knob {name!r}")
+        self._overrides[name] = value
+
+    def get(self, name: str) -> Any:
+        knob = _REGISTRY[name]
+        if name in self._overrides:
+            return self._overrides[name]
+        raw = os.environ.get("HVD_TPU_" + knob.name)
+        if raw is None and knob.alias:
+            raw = os.environ.get(knob.alias)
+        if raw is None:
+            return knob.default
+        try:
+            return knob.parser(raw)
+        except (TypeError, ValueError):
+            return knob.default
+
+    def snapshot(self) -> Dict[str, Any]:
+        return {name: self.get(name) for name in _REGISTRY}
+
+
+def knobs() -> Dict[str, Knob]:
+    """All registered knobs (used by the launcher to build CLI flags)."""
+    return dict(_REGISTRY)

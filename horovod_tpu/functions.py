@@ -1,0 +1,100 @@
+"""Parameter/object broadcast and gather utilities.
+
+Reference: horovod/torch/functions.py (broadcast_parameters,
+broadcast_optimizer_state, broadcast_object) and
+horovod/tensorflow/functions.py (broadcast_variables, broadcast_object).
+Used to seed all workers with rank-0 state at start-up and after elastic
+resets (SURVEY.md §5 checkpoint/resume).
+"""
+
+import io
+import pickle
+from typing import Any, Optional
+
+import numpy as np
+
+from . import basics as _basics
+from . import collectives as _c
+
+
+def broadcast_parameters(params: Any, root_rank: int = 0,
+                         process_set=None) -> Any:
+    """Broadcast a pytree of arrays from ``root_rank`` to every process and
+    return the synchronized pytree (reference: torch/functions.py
+    broadcast_parameters, which iterates state_dict entries and enqueues one
+    broadcast per tensor). Here the whole tree goes in deterministic leaf
+    order; each leaf is one named broadcast."""
+    import jax
+    leaves, treedef = jax.tree_util.tree_flatten(params)
+    out = []
+    for i, leaf in enumerate(leaves):
+        out.append(_c.broadcast(np.asarray(leaf), root_rank,
+                                name=f"broadcast_parameters.{i}",
+                                process_set=process_set))
+    return jax.tree_util.tree_unflatten(treedef, out)
+
+
+def broadcast_optimizer_state(opt_state: Any, root_rank: int = 0,
+                              process_set=None) -> Any:
+    """Broadcast an optax optimizer state pytree (reference:
+    torch/functions.py broadcast_optimizer_state, which walks
+    optimizer.state_dict; optax states are already pytrees of arrays +
+    static leaves, so array leaves broadcast and static leaves pass
+    through)."""
+    import jax
+    leaves, treedef = jax.tree_util.tree_flatten(opt_state)
+    out = []
+    for i, leaf in enumerate(leaves):
+        if isinstance(leaf, (int, float, complex, bool)) or leaf is None:
+            out.append(leaf)  # static hyperparams: identical by construction
+        else:
+            out.append(_c.broadcast(np.asarray(leaf), root_rank,
+                                    name=f"broadcast_opt_state.{i}",
+                                    process_set=process_set))
+    return jax.tree_util.tree_unflatten(treedef, out)
+
+
+def broadcast_object(obj: Any, root_rank: int = 0,
+                     name: Optional[str] = None, process_set=None) -> Any:
+    """Broadcast an arbitrary picklable object (reference:
+    torch/functions.py broadcast_object: pickle -> byte tensor -> broadcast
+    size then payload)."""
+    name = name or "broadcast_object"
+    w = _basics.world()
+    if w.rank() == root_rank:
+        payload = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
+        buf = np.frombuffer(payload, dtype=np.uint8).copy()
+    else:
+        buf = np.zeros((0,), dtype=np.uint8)
+    # size first (non-roots must allocate a matching-shape payload buffer;
+    # same two-phase shape negotiation as the reference)
+    size = np.array([buf.shape[0]], dtype=np.int64)
+    size = np.asarray(_c.broadcast(size, root_rank, name=f"{name}.size",
+                                   process_set=process_set))
+    n = int(size[0])
+    if buf.shape[0] != n:
+        buf = np.zeros((n,), dtype=np.uint8)
+    out = np.asarray(_c.broadcast(buf, root_rank, name=f"{name}.payload",
+                                  process_set=process_set))
+    return pickle.loads(out.tobytes())
+
+
+def allgather_object(obj: Any, name: Optional[str] = None,
+                     process_set=None) -> list:
+    """Gather one picklable object per process into a list ordered by rank
+    (reference: torch/mpi_ops.py allgather_object in later versions; uses
+    the ragged allgather underneath)."""
+    name = name or "allgather_object"
+    payload = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
+    buf = np.frombuffer(payload, dtype=np.uint8).copy().reshape(-1, 1)
+    sizes = np.asarray(_c.allgather(
+        np.array([[buf.shape[0]]], dtype=np.int64), name=f"{name}.sizes"))
+    gathered = np.asarray(_c.allgather(buf, name=f"{name}.payload",
+                                       process_set=process_set))
+    out = []
+    off = 0
+    for s in sizes.reshape(-1):
+        chunk = gathered[off:off + int(s), 0]
+        out.append(pickle.loads(chunk.tobytes()))
+        off += int(s)
+    return out

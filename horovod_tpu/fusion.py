@@ -1,0 +1,61 @@
+"""Gradient bucketing ("tensor fusion").
+
+The reference fuses many small gradient tensors into one 64 MB buffer before
+each collective (FusionBufferManager,
+/root/reference/horovod/common/fusion_buffer_manager.{h,cc}; response fusion
+with dtype look-ahead, controller.cc:640-761). On TPU, XLA already fuses the
+device-side copies; what bucketing still controls is *dispatch granularity* —
+how many XLA collective programs are launched per step and how much overlap
+is possible. Buckets are formed deterministically from traversal order, so
+every process builds identical buckets without negotiation (the compiled-SPMD
+replacement for the rank-0 negotiation protocol, SURVEY.md §5).
+"""
+
+from typing import Any, Callable, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+
+def plan_buckets(shapes_dtypes: Sequence[Tuple[tuple, Any]],
+                 threshold_bytes: int) -> List[List[int]]:
+    """Greedy in-order bucketing: consecutive tensors share a bucket until
+    adding the next would exceed ``threshold_bytes`` (mirrors FuseResponses'
+    size cap, controller.cc:640-761; dtype mixing is allowed because the
+    fused dispatch is one jit call, not one flat buffer).
+
+    threshold_bytes <= 0 disables fusion (one bucket per tensor), matching
+    HOROVOD_FUSION_THRESHOLD=0 semantics.
+    """
+    if threshold_bytes <= 0:
+        return [[i] for i in range(len(shapes_dtypes))]
+    buckets: List[List[int]] = []
+    cur: List[int] = []
+    cur_bytes = 0
+    for i, (shape, dtype) in enumerate(shapes_dtypes):
+        nbytes = int(np.prod(shape, dtype=np.int64)) * np.dtype(dtype).itemsize
+        if cur and cur_bytes + nbytes > threshold_bytes:
+            buckets.append(cur)
+            cur, cur_bytes = [], 0
+        cur.append(i)
+        cur_bytes += nbytes
+    if cur:
+        buckets.append(cur)
+    return buckets
+
+
+def bucketed_apply(values: List, threshold_bytes: int,
+                   fused_fn: Callable[[List, List[str]], List],
+                   names: Optional[List[str]] = None) -> List:
+    """Apply ``fused_fn(bucket_values, bucket_names) -> bucket_results`` per
+    bucket and reassemble results in input order."""
+    import jax.numpy as jnp
+    metas = [(tuple(np.shape(v)), jnp.asarray(v).dtype) for v in values]
+    buckets = plan_buckets(metas, threshold_bytes)
+    if names is None:
+        names = [f"tensor.{i}" for i in range(len(values))]
+    out: List = [None] * len(values)
+    for b in buckets:
+        results = fused_fn([values[i] for i in b], [names[i] for i in b])
+        for i, r in zip(b, results):
+            out[i] = r
+    return out

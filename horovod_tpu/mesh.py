@@ -1,0 +1,106 @@
+"""Device-mesh management for horovod_tpu.
+
+The reference organizes communication around a GLOBAL/LOCAL/CROSS communicator
+triple (/root/reference/horovod/common/common.h:111,
+common/mpi/mpi_context.cc:131-156) that enables hierarchical algorithms
+(NCCLHierarchicalAllreduce, ops/nccl_operations.cc:178-372). On TPU the same
+structure is a ``jax.sharding.Mesh`` whose axes map onto the interconnect:
+
+* ``'proc'``  — one slot per participating process. This is the axis eager
+  (host-plane) collectives reduce over; it corresponds to the reference's
+  GLOBAL communicator at process granularity.
+* within-process devices form the fast inner axis (ICI); cross-host/slice
+  traffic rides DCN. Hierarchical allreduce = reduce_scatter(inner) →
+  psum(outer) → all_gather(inner), expressed with shard_map in
+  :mod:`horovod_tpu.parallel.hierarchical`.
+
+Compiled-plane training uses richer meshes (dp/fsdp/tp/pp/sp/ep) built by
+:func:`make_training_mesh` in :mod:`horovod_tpu.parallel.mesh_utils`.
+"""
+
+from typing import List, Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+PROC_AXIS = "proc"
+
+
+class WorldMesh:
+    """The eager-plane mesh: one anchor device per participating process.
+
+    Eager collectives (allreduce/allgather/broadcast on host values, one value
+    per process — the reference's rank granularity) are expressed as jitted
+    reductions over the ``'proc'`` axis of this mesh. Remaining local devices
+    are not part of the eager plane; they belong to the compiled plane
+    (pjit/shard_map over training meshes).
+    """
+
+    def __init__(self, devices: Optional[Sequence[jax.Device]] = None):
+        if devices is None:
+            devices = _anchor_devices()
+        self._devices: List[jax.Device] = list(devices)
+        self.mesh = Mesh(np.array(self._devices), (PROC_AXIS,))
+        self.num_procs = len(self._devices)
+        # Stable cache key for compiled collective programs (id(mesh) could
+        # be reused after GC of an ephemeral subset mesh).
+        self.cache_key = tuple(d.id for d in self._devices)
+        local = set(d.id for d in jax.local_devices())
+        self._my_index = next(
+            (i for i, d in enumerate(self._devices) if d.id in local), -1)
+
+    @property
+    def is_member(self) -> bool:
+        return self._my_index >= 0
+
+    @property
+    def anchor_device(self) -> jax.Device:
+        if self._my_index < 0:
+            raise ValueError(
+                "this process has no device in the mesh/process set; only "
+                "member processes may call collectives on it")
+        return self._devices[self._my_index]
+
+    @property
+    def my_index(self) -> int:
+        if self._my_index < 0:
+            raise ValueError(
+                "this process has no device in the mesh/process set; only "
+                "member processes may call collectives on it")
+        return self._my_index
+
+    def stacked_sharding(self) -> NamedSharding:
+        """Sharding for a (num_procs, ...) array with one row per process."""
+        return NamedSharding(self.mesh, P(PROC_AXIS))
+
+    def replicated_sharding(self) -> NamedSharding:
+        return NamedSharding(self.mesh, P())
+
+    def subset(self, proc_indices: Sequence[int]) -> "WorldMesh":
+        """Sub-mesh over a subset of processes (reference: process sets via
+        hvd.init(ranks), basics.py:33-65, operations.cc:624-628)."""
+        return WorldMesh([self._devices[i] for i in proc_indices])
+
+
+def _anchor_devices() -> List[jax.Device]:
+    """First local device of each process, ordered by process index.
+
+    With one process (the common TPU single-controller case) this is just
+    ``[devices[0]]``; with N processes it yields one device per process.
+    """
+    devices = jax.devices()
+    by_proc = {}
+    for d in devices:
+        by_proc.setdefault(d.process_index, d)
+    return [by_proc[p] for p in sorted(by_proc)]
+
+
+def full_mesh(axis_name: str = "world") -> Mesh:
+    """A 1-D mesh over every addressable device, device-granular.
+
+    This is the axis data-parallel compiled training reduces over — the
+    TPU-native analogue of the reference's world communicator at GPU
+    granularity.
+    """
+    return Mesh(np.array(jax.devices()), (axis_name,))

@@ -1,0 +1,84 @@
+"""Stall inspector for horovod_tpu.
+
+TPU-native analogue of the reference StallInspector
+(/root/reference/horovod/common/stall_inspector.{h,cc}): tracks when each
+named tensor was submitted and warns when one has been waiting longer than
+``HVD_TPU_STALL_CHECK_TIME_SECONDS`` (default 60 s, stall_inspector.h:75).
+With ``HVD_TPU_STALL_SHUTDOWN_TIME_SECONDS`` > 0, a stalled tensor raises
+:class:`~horovod_tpu.exceptions.StallError` on the waiting thread / terminates
+the job (stall_inspector.h:80 semantics).
+
+In the reference a stall means "some ranks never submitted tensor X"; in the
+compiled SPMD world the analogous failure is a collective stuck inside a jitted
+step (peer down, DCN partition) or an eager submission never synchronized. The
+inspector watches both: entries are registered on submission and cleared on
+completion, and a daemon thread periodically reports laggards.
+"""
+
+import threading
+import time
+from typing import Dict
+
+from . import config as _config
+from .exceptions import StallError
+
+
+class StallInspector:
+    def __init__(self, world):
+        self._cfg = world.config
+        self._world = world
+        self._lock = threading.Lock()
+        self._pending: Dict[str, float] = {}
+        self._warned: Dict[str, bool] = {}
+        self._stop_evt = threading.Event()
+        self._shutdown_deadline_hit = False
+        self._thread = None
+        if not self._cfg.get(_config.STALL_CHECK_DISABLE):
+            self._thread = threading.Thread(
+                target=self._loop, name="hvd_tpu_stall", daemon=True)
+            self._thread.start()
+
+    # -- registration --------------------------------------------------------
+    def record_submit(self, name: str):
+        with self._lock:
+            self._pending.setdefault(name, time.monotonic())
+
+    def record_done(self, name: str):
+        with self._lock:
+            self._pending.pop(name, None)
+            self._warned.pop(name, None)
+
+    def check_shutdown(self):
+        """Called from synchronize(); raises if the shutdown deadline was hit."""
+        if self._shutdown_deadline_hit:
+            raise StallError(
+                "horovod_tpu: collective stalled beyond "
+                "HVD_TPU_STALL_SHUTDOWN_TIME_SECONDS; shutting down.")
+
+    # -- background loop -----------------------------------------------------
+    def _loop(self):
+        import logging
+        log = logging.getLogger("horovod_tpu")
+        warn_after = self._cfg.get(_config.STALL_CHECK_TIME_SECONDS)
+        shutdown_after = self._cfg.get(_config.STALL_SHUTDOWN_TIME_SECONDS)
+        poll = min(max(warn_after / 4.0, 0.25), 10.0)
+        while not self._stop_evt.wait(poll):
+            now = time.monotonic()
+            with self._lock:
+                items = list(self._pending.items())
+            for name, t0 in items:
+                waited = now - t0
+                if waited > warn_after and not self._warned.get(name):
+                    self._warned[name] = True
+                    log.warning(
+                        "One or more collectives stalled for over %.0fs: %s. "
+                        "This may indicate that a peer process is down or a "
+                        "different subset of collectives was submitted on "
+                        "another process.", warn_after, name)
+                if shutdown_after > 0 and waited > shutdown_after:
+                    self._shutdown_deadline_hit = True
+
+    def stop(self):
+        self._stop_evt.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)

@@ -1,0 +1,96 @@
+"""Named-tensor table and async handle management.
+
+TPU-native analogue of the reference's TensorQueue + HandleManager:
+
+* the reference stages submissions in a mutex-protected ``TensorQueue`` that
+  rejects duplicate in-flight names (DUPLICATE_NAME_ERROR,
+  /root/reference/horovod/common/tensor_queue.{h,cc}) and hands them to the
+  background thread;
+* the Torch binding maps each submission to an integer handle resolved by a
+  ``HandleManager`` (/root/reference/horovod/torch/handle_manager.{h,cc});
+* the controller validates that every rank submitted the same dtype/shape/op
+  for a given name (controller.cc:378-611).
+
+Here submissions dispatch through XLA immediately (JAX's async dispatch plays
+the role of the background thread + finalizer pool,
+gpu_operations.cc:60-87), so the table's jobs are: duplicate-name detection,
+handle bookkeeping, stall-inspector registration, and (optionally, knob
+``HVD_TPU_CHECK_CONSISTENCY``) cross-process metadata validation.
+"""
+
+import threading
+import zlib
+from typing import Any, Callable, Dict, Optional
+
+from .exceptions import DuplicateNameError
+
+
+class Handle:
+    """An in-flight collective. Resolved by ``synchronize()``/``poll()``
+    (reference: torch/mpi_ops.py:463-517)."""
+
+    __slots__ = ("id", "name", "result", "error", "_ready_fn", "_finalize_fn")
+
+    def __init__(self, hid: int, name: str):
+        self.id = hid
+        self.name = name
+        self.result = None
+        self.error: Optional[BaseException] = None
+        self._ready_fn: Optional[Callable[[], bool]] = None
+        self._finalize_fn: Optional[Callable[[], Any]] = None
+
+
+class TensorTable:
+    def __init__(self, world):
+        self._world = world
+        self._lock = threading.Lock()
+        self._in_flight: Dict[str, int] = {}
+        self._handles: Dict[int, Handle] = {}
+        self._next_handle = 0
+
+    def begin(self, name: str, kind: str) -> Handle:
+        """Register an in-flight named op. Raises DuplicateNameError when the
+        name is already pending (reference tensor_queue.cc duplicate check)."""
+        with self._lock:
+            if name in self._in_flight:
+                raise DuplicateNameError(
+                    f"Requested to {kind} a tensor with the same name as "
+                    f"another tensor that is currently being processed: "
+                    f"{name!r}. If you want to request another tensor, pass "
+                    f"a different name.")
+            hid = self._next_handle
+            self._next_handle += 1
+            h = Handle(hid, name)
+            self._in_flight[name] = hid
+            self._handles[hid] = h
+        insp = self._world.stall_inspector
+        if insp is not None:
+            insp.record_submit(name)
+        return h
+
+    def finish(self, handle: Handle):
+        with self._lock:
+            self._in_flight.pop(handle.name, None)
+            self._handles.pop(handle.id, None)
+        insp = self._world.stall_inspector
+        if insp is not None:
+            insp.record_done(handle.name)
+
+    def get(self, hid: int) -> Handle:
+        with self._lock:
+            h = self._handles.get(hid)
+        if h is None:
+            raise ValueError(f"unknown or already-synchronized handle {hid}")
+        return h
+
+    def pending_count(self) -> int:
+        with self._lock:
+            return len(self._in_flight)
+
+
+def metadata_fingerprint(name: str, shape, dtype, kind: str, extra: str = "") -> int:
+    """Stable 32-bit fingerprint of a submission's metadata, used for the
+    cross-process consistency check (the TPU-shaped stand-in for the
+    reference controller's per-cycle dtype/shape validation)."""
+    key = f"{name}|{tuple(shape)}|{dtype}|{kind}|{extra}".encode()
+    return zlib.crc32(key)

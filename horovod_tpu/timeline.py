@@ -1,0 +1,170 @@
+"""Chrome-tracing timeline for horovod_tpu.
+
+TPU-native analogue of the reference Timeline
+(/root/reference/horovod/common/timeline.{h,cc}): a dedicated writer thread
+drains a record queue and emits chrome://tracing JSON (timeline.h:47-75). The
+per-tensor state machine NEGOTIATING -> TOP_LEVEL -> ACTIVITY (timeline.h:77-99)
+is preserved for host-side phases (QUEUE, FUSE, DISPATCH, WAIT_FOR_DATA);
+device-side detail comes from ``jax.profiler`` traces, which can be captured
+alongside (``Timeline.start_jax_trace``) and viewed in the same tooling.
+
+Enable with ``HVD_TPU_TIMELINE=<file>`` (alias ``HOROVOD_TIMELINE``); only the
+coordinator process writes (reference: operations.cc:407-415 opens the file on
+rank 0 only).
+"""
+
+import json
+import queue
+import threading
+import time
+from typing import Optional
+
+from . import config as _config
+
+# Host-side activity names, mirroring the reference's
+# (/root/reference/horovod/common/common.h:31-59).
+QUEUE = "QUEUE"
+FUSE = "FUSE"
+DISPATCH = "DISPATCH"
+WAIT_FOR_DATA = "WAIT_FOR_DATA"
+MEMCPY_IN_FUSION_BUFFER = "MEMCPY_IN_FUSION_BUFFER"
+MEMCPY_OUT_FUSION_BUFFER = "MEMCPY_OUT_FUSION_BUFFER"
+XLA_ALLREDUCE = "XLA_ALLREDUCE"
+XLA_ALLGATHER = "XLA_ALLGATHER"
+XLA_BROADCAST = "XLA_BROADCAST"
+XLA_ALLTOALL = "XLA_ALLTOALL"
+NEGOTIATE = "NEGOTIATE"
+
+
+class Timeline:
+    """Thread-safe chrome-tracing writer. All public methods are cheap when
+    disabled (no-op guard on first line)."""
+
+    def __init__(self, path: str, mark_cycles: bool = False):
+        self._path = path
+        self._mark_cycles = mark_cycles
+        self._q: "queue.Queue[Optional[dict]]" = queue.Queue()
+        self._tids = {}
+        self._next_tid = 1
+        self._lock = threading.Lock()
+        self._t0 = time.perf_counter()
+        self._closed = False
+        self._thread = threading.Thread(
+            target=self._writer, name="hvd_tpu_timeline", daemon=True)
+        self._thread.start()
+
+    @property
+    def enabled(self) -> bool:
+        return not self._closed
+
+    def _now_us(self) -> float:
+        return (time.perf_counter() - self._t0) * 1e6
+
+    def _tid(self, tensor_name: str) -> int:
+        with self._lock:
+            tid = self._tids.get(tensor_name)
+            if tid is None:
+                tid = self._next_tid
+                self._next_tid += 1
+                self._tids[tensor_name] = tid
+                self._q.put({"name": "thread_name", "ph": "M", "pid": 0,
+                             "tid": tid, "args": {"name": tensor_name}})
+            return tid
+
+    def _emit(self, name, ph, tensor_name, args=None):
+        if self._closed:
+            return
+        ev = {"name": name, "ph": ph, "pid": 0, "tid": self._tid(tensor_name),
+              "ts": self._now_us()}
+        if args:
+            ev["args"] = args
+        self._q.put(ev)
+
+    # -- per-tensor lifecycle (reference: timeline.h:77-99) ------------------
+    def negotiate_start(self, tensor_name: str, op_name: str):
+        self._emit(NEGOTIATE + "_" + op_name.upper(), "B", tensor_name)
+
+    def negotiate_rank_ready(self, tensor_name: str, rank: int):
+        self._emit("RANK_READY", "i", tensor_name, {"rank": rank})
+
+    def negotiate_end(self, tensor_name: str):
+        self._emit("NEGOTIATE", "E", tensor_name)
+
+    def start(self, tensor_name: str, op_name: str, nbytes: int = 0):
+        self._emit(op_name.upper(), "B", tensor_name,
+                   {"bytes": nbytes} if nbytes else None)
+
+    def activity_start(self, tensor_name: str, activity: str):
+        self._emit(activity, "B", tensor_name)
+
+    def activity_end(self, tensor_name: str):
+        # chrome tracing closes the innermost open B for this tid
+        if self._closed:
+            return
+        self._q.put({"name": "", "ph": "E", "pid": 0,
+                     "tid": self._tid(tensor_name), "ts": self._now_us()})
+
+    def end(self, tensor_name: str):
+        self.activity_end(tensor_name)
+
+    def mark_cycle(self):
+        if self._mark_cycles and not self._closed:
+            self._q.put({"name": "CYCLE", "ph": "i", "pid": 0, "tid": 0,
+                         "ts": self._now_us(), "s": "g"})
+
+    # -- device-side: splice in the XLA profiler -----------------------------
+    def start_jax_trace(self, logdir: str):
+        import jax
+        jax.profiler.start_trace(logdir)
+
+    def stop_jax_trace(self):
+        import jax
+        jax.profiler.stop_trace()
+
+    # -- writer --------------------------------------------------------------
+    def _writer(self):
+        # Stream events to disk as they arrive (reference: timeline.cc writer
+        # thread appends continuously) so the trace survives abnormal exit —
+        # the primary use of a timeline is debugging jobs that hang or die.
+        # Chrome tracing's JSON-array format tolerates a missing ']', so a
+        # killed job still leaves a loadable trace.
+        with open(self._path, "w") as f:
+            f.write("[\n")
+            n = 0
+            while True:
+                ev = self._q.get()
+                if ev is None:
+                    break
+                f.write(json.dumps(ev))
+                f.write(",\n")
+                n += 1
+                if n % 50 == 0 or self._q.empty():
+                    f.flush()
+            f.write("{}]\n")
+
+    def close(self):
+        if self._closed:
+            return
+        self._closed = True
+        self._q.put(None)
+        self._thread.join(timeout=10)
+
+
+class _NullTimeline:
+    enabled = False
+
+    def __getattr__(self, name):
+        return lambda *a, **k: None
+
+    def close(self):
+        pass
+
+
+NULL_TIMELINE = _NullTimeline()
+
+
+def maybe_start_timeline(world) -> object:
+    path = world.config.get(_config.TIMELINE)
+    if not path or world.process_id != 0:
+        return NULL_TIMELINE
+    return Timeline(path, world.config.get(_config.TIMELINE_MARK_CYCLES))

@@ -1,0 +1,108 @@
+"""Worker script for multi-process integration tests.
+
+Spawned N times by test_multiprocess_integration.py (the stand-in for the
+reference's `horovodrun`-launched suites, test/test_torch.py run under 2+
+processes). Each process gets one CPU device, inits horovod_tpu against a
+shared coordinator, and validates eager collective results against local
+math. Exit code 0 = all assertions passed.
+"""
+
+import os
+import sys
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ.pop("XLA_FLAGS", None)
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)
+
+import numpy as np  # noqa: E402
+
+import horovod_tpu as hvd  # noqa: E402
+
+
+def main():
+    hvd.init()
+    rank, size = hvd.rank(), hvd.size()
+    assert size == int(os.environ["HVD_TPU_SIZE"]), (size, os.environ["HVD_TPU_SIZE"])
+
+    # -- allreduce: sum and average over distinct per-rank values ------------
+    x = np.full((5, 3), float(rank + 1), np.float32)
+    out = np.asarray(hvd.allreduce(x, op=hvd.Sum, name="ar_sum"))
+    np.testing.assert_allclose(out, np.full((5, 3), size * (size + 1) / 2))
+    out = np.asarray(hvd.allreduce(x, name="ar_avg"))
+    np.testing.assert_allclose(out, np.full((5, 3), (size + 1) / 2))
+
+    # int sum
+    xi = np.full((4,), rank + 1, np.int64)
+    out = np.asarray(hvd.allreduce(xi, op=hvd.Sum, name="ar_int"))
+    np.testing.assert_array_equal(out, np.full((4,), size * (size + 1) // 2))
+
+    # min/max
+    out = np.asarray(hvd.allreduce(x, op=hvd.Max, name="ar_max"))
+    np.testing.assert_allclose(out, np.full((5, 3), float(size)))
+
+    # grouped
+    xs = [np.full((3,), float(rank), np.float32),
+          np.full((2, 2), float(rank * 2), np.float32)]
+    outs = hvd.grouped_allreduce(xs, op=hvd.Sum, name="grp")
+    np.testing.assert_allclose(np.asarray(outs[0]),
+                               np.full((3,), sum(range(size))))
+    np.testing.assert_allclose(np.asarray(outs[1]),
+                               np.full((2, 2), 2.0 * sum(range(size))))
+
+    # -- allgather: uniform and ragged first dims ----------------------------
+    g = np.asarray(hvd.allgather(np.full((2, 3), float(rank), np.float32),
+                                 name="ag_uniform"))
+    expected = np.concatenate(
+        [np.full((2, 3), float(r), np.float32) for r in range(size)])
+    np.testing.assert_allclose(g, expected)
+
+    ragged = np.arange((rank + 1) * 2, dtype=np.float32).reshape(rank + 1, 2)
+    g = np.asarray(hvd.allgather(ragged, name="ag_ragged"))
+    expected = np.concatenate(
+        [np.arange((r + 1) * 2, dtype=np.float32).reshape(r + 1, 2)
+         for r in range(size)])
+    np.testing.assert_allclose(g, expected)
+
+    # -- broadcast -----------------------------------------------------------
+    root_val = np.arange(6, dtype=np.float32).reshape(2, 3) * 7
+    mine = root_val if rank == 1 else np.zeros((2, 3), np.float32)
+    out = np.asarray(hvd.broadcast(mine, root_rank=1, name="bc"))
+    np.testing.assert_allclose(out, root_val)
+
+    # -- alltoall ------------------------------------------------------------
+    send = np.arange(size * 2, dtype=np.float32) + 100 * rank
+    out = np.asarray(hvd.alltoall(send, name="a2a"))
+    expected = np.concatenate(
+        [np.arange(rank * 2, rank * 2 + 2, dtype=np.float32) + 100 * r
+         for r in range(size)])
+    np.testing.assert_allclose(out, expected)
+
+    # -- adasum (power-of-two sizes only) ------------------------------------
+    if size & (size - 1) == 0:
+        a = np.zeros((size, 4), np.float32)
+        a[rank, rank % 4] = float(rank + 1)
+        out = np.asarray(hvd.allreduce(a, op=hvd.Adasum, name="adasum"))
+        assert out.shape == (size, 4)
+
+    # -- async handles -------------------------------------------------------
+    hs = [hvd.allreduce_async(np.full((4,), float(rank + i), np.float32),
+                              op=hvd.Sum, name=f"async_{i}")
+          for i in range(4)]
+    for i, h in enumerate(hs):
+        out = np.asarray(hvd.synchronize(h))
+        np.testing.assert_allclose(
+            out, np.full((4,), sum(r + i for r in range(size))))
+
+    # -- barrier -------------------------------------------------------------
+    hvd.barrier()
+
+    hvd.shutdown()
+    print(f"worker {rank} OK", flush=True)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,87 @@
+"""Adasum numeric tests: recompute the pairwise rule in NumPy and compare
+(reference: /root/reference/test/test_adasum_pytorch.py:1-210, which validates
+hvd.allreduce(op=Adasum) against the same formula)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import horovod_tpu as hvd
+from horovod_tpu import adasum as A
+
+
+def np_adasum_pair(a, b):
+    a = a.astype(np.float64)
+    b = b.astype(np.float64)
+    dot = np.sum(a * b)
+    na = np.sum(a * a)
+    nb = np.sum(b * b)
+    ca = 0.0 if na == 0 else 1.0 - dot / (2 * na)
+    cb = 0.0 if nb == 0 else 1.0 - dot / (2 * nb)
+    return ca * a + cb * b
+
+
+def np_adasum_tree(rows):
+    level = list(rows)
+    while len(level) > 1:
+        level = [np_adasum_pair(level[2 * i], level[2 * i + 1])
+                 for i in range(len(level) // 2)]
+    return level[0]
+
+
+def test_adasum_pair_identical_is_identity():
+    # scale invariance: adasum(a, a) == a (the defining property)
+    a = jnp.asarray(np.random.RandomState(0).randn(32).astype(np.float32))
+    out = np.asarray(A.adasum_pair(a, a))
+    np.testing.assert_allclose(out, np.asarray(a), rtol=1e-5)
+
+
+def test_adasum_pair_orthogonal_is_sum():
+    a = jnp.asarray(np.array([1.0, 0.0, 0.0, 0.0], np.float32))
+    b = jnp.asarray(np.array([0.0, 2.0, 0.0, 0.0], np.float32))
+    out = np.asarray(A.adasum_pair(a, b))
+    np.testing.assert_allclose(out, [1.0, 2.0, 0.0, 0.0], rtol=1e-6)
+
+
+def test_adasum_pair_zero_operand():
+    a = jnp.zeros((4,), jnp.float32)
+    b = jnp.asarray(np.array([1.0, 2.0, 3.0, 4.0], np.float32))
+    out = np.asarray(A.adasum_pair(a, b))
+    np.testing.assert_allclose(out, np.asarray(b), rtol=1e-6)
+
+
+@pytest.mark.parametrize("n", [2, 4, 8])
+def test_adasum_tree_matches_numpy(n):
+    rng = np.random.RandomState(7)
+    rows = rng.randn(n, 64).astype(np.float32)
+    out = np.asarray(jax.jit(A.adasum_tree)(jnp.asarray(rows)))
+    expected = np_adasum_tree(rows)
+    np.testing.assert_allclose(out, expected, rtol=1e-4, atol=1e-5)
+
+
+def test_adasum_tree_non_pow2_raises():
+    with pytest.raises(ValueError):
+        A.adasum_tree(jnp.zeros((3, 4), jnp.float32))
+
+
+def test_adasum_eager_size1(hvd_world):
+    x = np.random.RandomState(1).randn(16).astype(np.float32)
+    out = hvd.allreduce(x, op=hvd.Adasum)
+    np.testing.assert_allclose(np.asarray(out), x, rtol=1e-6)
+
+
+def test_adasum_in_jit_over_mesh(hvd_world, mesh8):
+    from jax.sharding import PartitionSpec as P
+    from jax import shard_map
+    rng = np.random.RandomState(3)
+    rows = rng.randn(8, 32).astype(np.float32)
+
+    def step(g):
+        return A.adasum_grads(g, outer_axis="world")
+    f = shard_map(step, mesh=mesh8, in_specs=P("world"), out_specs=P("world"))
+    out = np.asarray(jax.jit(f)(rows))
+    expected = np_adasum_tree(rows)
+    for d in range(8):
+        np.testing.assert_allclose(out[d], expected, rtol=1e-4, atol=1e-5)

@@ -1,0 +1,64 @@
+"""World lifecycle and query tests (reference: test/test_torch.py rank/size
+smoke tests + basics.py API surface)."""
+
+import pytest
+
+import horovod_tpu as hvd
+from horovod_tpu.exceptions import NotInitializedError
+
+
+def test_init_rank_size(hvd_world):
+    assert hvd.is_initialized()
+    assert hvd.rank() == 0
+    assert hvd.size() == 1
+    assert hvd.local_rank() == 0
+    assert hvd.local_size() == 1
+    assert hvd.cross_rank() == 0
+    assert hvd.cross_size() == 1
+    assert hvd.device_count() == 8
+    assert hvd.local_device_count() == 8
+    assert hvd.dp_size() == 8
+    assert hvd.is_homogeneous()
+
+
+def test_double_init_is_noop(hvd_world):
+    hvd.init()
+    assert hvd.size() == 1
+
+
+def test_shutdown_then_reinit(hvd_world):
+    hvd.shutdown()
+    assert not hvd.is_initialized()
+    hvd.init()
+    assert hvd.is_initialized()
+
+
+def test_not_initialized_raises():
+    if hvd.is_initialized():
+        hvd.shutdown()
+    with pytest.raises(NotInitializedError):
+        hvd.rank()
+    with pytest.raises(NotInitializedError):
+        hvd.size()
+
+
+def test_capability_queries(hvd_world):
+    assert hvd.xla_built()
+    assert not hvd.mpi_built()
+    assert not hvd.nccl_built()
+    assert not hvd.gloo_built()
+    assert not hvd.cuda_built()
+    assert not hvd.mpi_enabled()
+    assert not hvd.mpi_threads_supported()
+    assert isinstance(hvd.tpu_available(), bool)
+
+
+def test_process_sets(hvd_world):
+    hvd.shutdown()
+    hvd.init(process_sets=[[0]])
+    wm = hvd.process_set_mesh(0)
+    assert wm.num_procs == 1
+
+
+def test_hostname(hvd_world):
+    assert isinstance(hvd.hostname(), str) and hvd.hostname()
