@@ -1,0 +1,44 @@
+#!/usr/bin/env python
+"""Headline benchmark: ResNet-50 synthetic training throughput.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+
+Mirrors the reference's synthetic benchmark defaults
+(/root/reference/examples/tensorflow2_synthetic_benchmark.py: ResNet-50,
+batch 32/worker, 10 warmup, 10 iters x 10 batches). ``vs_baseline`` is
+measured against the only absolute throughput the reference publishes:
+docs/benchmarks.rst:27-43, total images/sec 1656.82 on 16 Pascal GPUs for
+ResNet-101 batch 64 => 103.55 img/s/GPU (closest available anchor; the
+512-GPU chart publishes only scaling efficiency).
+"""
+
+import json
+import sys
+
+REFERENCE_IMG_PER_SEC_PER_CHIP = 1656.82 / 16  # docs/benchmarks.rst:27-43
+
+
+def main():
+    from horovod_tpu.benchmark import synthetic_resnet50_benchmark
+
+    batch = 32
+    for a in sys.argv[1:]:
+        if a.startswith("--batch="):
+            batch = int(a.split("=", 1)[1])
+
+    r = synthetic_resnet50_benchmark(batch_per_chip=batch)
+    print(json.dumps({
+        "metric": "resnet50_synthetic_images_per_sec_per_chip",
+        "value": round(r.images_per_sec_per_chip, 2),
+        "unit": "images/sec/chip",
+        "vs_baseline": round(
+            r.images_per_sec_per_chip / REFERENCE_IMG_PER_SEC_PER_CHIP, 3),
+        "num_chips": r.num_chips,
+        "batch_per_chip": r.batch_per_chip,
+        "total_images_per_sec": round(r.images_per_sec_total, 2),
+    }))
+
+
+if __name__ == "__main__":
+    main()

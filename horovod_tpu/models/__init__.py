@@ -1,0 +1,12 @@
+"""Model zoo for horovod_tpu benchmarks and examples.
+
+The reference ships per-framework example models (ResNet-50/MNIST synthetic
+benchmarks, /root/reference/examples/tensorflow2_synthetic_benchmark.py,
+pytorch_synthetic_benchmark.py, *_mnist.py). Here the models are flax modules
+designed TPU-first: bfloat16 compute with fp32 params/accumulators, shapes
+that tile onto the 128x128 MXU, and no data-dependent Python control flow.
+"""
+
+from .resnet import ResNet, ResNet18, ResNet34, ResNet50, ResNet101, ResNet152  # noqa: F401
+from .mlp import MLP  # noqa: F401
+from .transformer import Transformer, TransformerConfig  # noqa: F401

@@ -1,0 +1,22 @@
+"""Small MLP, the MNIST-class model of the reference examples
+(/root/reference/examples/pytorch_mnist.py Net). Used by tests and the
+mnist example."""
+
+from typing import Any, Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class MLP(nn.Module):
+    features: Sequence[int] = (128, 128)
+    num_classes: int = 10
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        x = x.reshape((x.shape[0], -1)).astype(self.dtype)
+        for f in self.features:
+            x = nn.relu(nn.Dense(f, dtype=self.dtype)(x))
+        return nn.Dense(self.num_classes, dtype=jnp.float32)(
+            x.astype(jnp.float32))

@@ -1,0 +1,140 @@
+"""Decoder-only transformer, TPU-first.
+
+The reference has no transformer (its benchmarks are CNNs), but the TPU
+build's parallelism strategies (TP/SP/PP/EP/ring attention — SURVEY.md §2.3,
+§7 stage 8) need a first-class transformer to exercise them. Design:
+
+* bfloat16 activations, fp32 params; all projections are einsums with
+  explicit head axes so tensor parallelism is a sharding annotation, not a
+  rewrite (heads shard over 'tp', hidden shards over 'tp' in the MLP).
+* flax ``nn.with_logical_partitioning`` names every parameter axis
+  ('embed', 'heads', 'kv', 'mlp', 'vocab'); horovod_tpu.parallel maps those
+  logical names onto mesh axes (dp/fsdp/tp/sp) — the pjit idiom.
+* causal attention runs through :func:`attention_fn` injection so context
+  parallelism (ring attention over 'sp' via ppermute) and Pallas
+  flash-attention kernels plug in without touching the model.
+"""
+
+import dataclasses
+from typing import Any, Callable, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+Dtype = Any
+
+
+@dataclasses.dataclass(frozen=True)
+class TransformerConfig:
+    vocab_size: int = 32000
+    num_layers: int = 12
+    d_model: int = 768
+    num_heads: int = 12
+    head_dim: int = 64
+    mlp_ratio: int = 4
+    max_seq_len: int = 2048
+    dtype: Dtype = jnp.bfloat16
+    dropout_rate: float = 0.0
+    # injected attention implementation; default = XLA softmax attention
+    attention_fn: Optional[Callable] = None
+    remat: bool = False
+
+
+def _default_attention(q, k, v, mask, dtype):
+    """Plain softmax attention: (B, S, H, D) inputs, causal mask applied.
+    Softmax in fp32 (TPU recipe: keep reductions out of bf16)."""
+    depth = q.shape[-1]
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32)
+    scores = scores / jnp.sqrt(depth).astype(jnp.float32)
+    if mask is not None:
+        scores = jnp.where(mask, scores, jnp.finfo(jnp.float32).min)
+    probs = jax.nn.softmax(scores, axis=-1).astype(dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+
+
+class Attention(nn.Module):
+    cfg: TransformerConfig
+
+    @nn.compact
+    def __call__(self, x, mask):
+        cfg = self.cfg
+        H, D = cfg.num_heads, cfg.head_dim
+        wq = self.param("wq", nn.with_logical_partitioning(
+            nn.initializers.normal(0.02), ("embed", "heads", "kv")),
+            (cfg.d_model, H, D), jnp.float32)
+        wk = self.param("wk", nn.with_logical_partitioning(
+            nn.initializers.normal(0.02), ("embed", "heads", "kv")),
+            (cfg.d_model, H, D), jnp.float32)
+        wv = self.param("wv", nn.with_logical_partitioning(
+            nn.initializers.normal(0.02), ("embed", "heads", "kv")),
+            (cfg.d_model, H, D), jnp.float32)
+        wo = self.param("wo", nn.with_logical_partitioning(
+            nn.initializers.normal(0.02), ("heads", "kv", "embed")),
+            (H, D, cfg.d_model), jnp.float32)
+        dt = cfg.dtype
+        q = jnp.einsum("bse,ehd->bshd", x, wq.astype(dt))
+        k = jnp.einsum("bse,ehd->bshd", x, wk.astype(dt))
+        v = jnp.einsum("bse,ehd->bshd", x, wv.astype(dt))
+        attn = cfg.attention_fn or _default_attention
+        out = attn(q, k, v, mask, dt)
+        return jnp.einsum("bshd,hde->bse", out, wo.astype(dt))
+
+
+class MlpBlock(nn.Module):
+    cfg: TransformerConfig
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.cfg
+        hidden = cfg.d_model * cfg.mlp_ratio
+        wi = self.param("wi", nn.with_logical_partitioning(
+            nn.initializers.normal(0.02), ("embed", "mlp")),
+            (cfg.d_model, hidden), jnp.float32)
+        wo = self.param("wo", nn.with_logical_partitioning(
+            nn.initializers.normal(0.02), ("mlp", "embed")),
+            (hidden, cfg.d_model), jnp.float32)
+        dt = cfg.dtype
+        h = jnp.einsum("bse,em->bsm", x, wi.astype(dt))
+        h = nn.gelu(h)
+        return jnp.einsum("bsm,me->bse", h, wo.astype(dt))
+
+
+class DecoderLayer(nn.Module):
+    cfg: TransformerConfig
+
+    @nn.compact
+    def __call__(self, x, mask):
+        cfg = self.cfg
+        ln = lambda name: nn.LayerNorm(  # noqa: E731
+            dtype=cfg.dtype, param_dtype=jnp.float32, name=name)
+        x = x + Attention(cfg, name="attn")(ln("ln1")(x), mask)
+        x = x + MlpBlock(cfg, name="mlp")(ln("ln2")(x))
+        return x
+
+
+class Transformer(nn.Module):
+    cfg: TransformerConfig
+
+    @nn.compact
+    def __call__(self, tokens):
+        cfg = self.cfg
+        B, S = tokens.shape
+        emb = self.param("embedding", nn.with_logical_partitioning(
+            nn.initializers.normal(0.02), ("vocab", "embed")),
+            (cfg.vocab_size, cfg.d_model), jnp.float32)
+        pos = self.param("pos_embedding", nn.with_logical_partitioning(
+            nn.initializers.normal(0.02), (None, "embed")),
+            (cfg.max_seq_len, cfg.d_model), jnp.float32)
+        x = emb.astype(cfg.dtype)[tokens] + pos.astype(cfg.dtype)[None, :S]
+        mask = jnp.tril(jnp.ones((S, S), jnp.bool_))[None, None]
+        layer_cls = DecoderLayer
+        if cfg.remat:
+            layer_cls = nn.remat(DecoderLayer, static_argnums=())
+        for i in range(cfg.num_layers):
+            x = layer_cls(cfg, name=f"layer_{i}")(x, mask)
+        x = nn.LayerNorm(dtype=cfg.dtype, param_dtype=jnp.float32,
+                         name="ln_f")(x)
+        # logits in fp32, weight-tied to the embedding
+        return jnp.einsum("bse,ve->bsv", x.astype(jnp.float32),
+                          emb.astype(jnp.float32))

@@ -1,0 +1,104 @@
+"""Model zoo + benchmark machinery + driver entry tests."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from horovod_tpu.models import (MLP, ResNet18, ResNet50, Transformer,
+                                TransformerConfig)
+
+
+def test_mlp_forward():
+    m = MLP(features=(32,), num_classes=10)
+    x = jnp.zeros((4, 28, 28, 1))
+    v = m.init(jax.random.PRNGKey(0), x)
+    out = m.apply(v, x)
+    assert out.shape == (4, 10)
+    assert out.dtype == jnp.float32
+
+
+def test_resnet18_forward_small():
+    m = ResNet18(num_classes=10, num_filters=8)
+    x = jnp.zeros((2, 32, 32, 3), jnp.bfloat16)
+    v = m.init(jax.random.PRNGKey(0), x, train=False)
+    out = m.apply(v, x, train=False)
+    assert out.shape == (2, 10)
+    assert out.dtype == jnp.float32  # fp32 head
+
+
+def test_resnet_batchstats_update():
+    m = ResNet18(num_classes=10, num_filters=8)
+    x = jnp.ones((2, 32, 32, 3), jnp.bfloat16)
+    v = m.init(jax.random.PRNGKey(0), x, train=True)
+    _, updates = m.apply(v, x, train=True, mutable=["batch_stats"])
+    # running stats must move away from init
+    leaves = jax.tree_util.tree_leaves(updates["batch_stats"])
+    assert any(bool(jnp.any(l != 0) & jnp.any(jnp.isfinite(l)))
+               for l in leaves)
+
+
+def test_resnet50_param_count():
+    m = ResNet50(num_classes=1000)
+    v = jax.eval_shape(
+        lambda: m.init(jax.random.PRNGKey(0),
+                       jnp.zeros((1, 224, 224, 3), jnp.bfloat16),
+                       train=False))
+    n = sum(int(np.prod(l.shape))
+            for l in jax.tree_util.tree_leaves(v["params"]))
+    # torchvision resnet50: 25,557,032 params — v1.5-compatible definition
+    assert abs(n - 25_557_032) / 25_557_032 < 0.01, n
+
+
+def test_transformer_forward():
+    cfg = TransformerConfig(vocab_size=64, num_layers=2, d_model=32,
+                            num_heads=2, head_dim=16, max_seq_len=16,
+                            dtype=jnp.float32)
+    m = Transformer(cfg)
+    toks = jnp.zeros((2, 8), jnp.int32)
+    v = m.init(jax.random.PRNGKey(0), toks)
+    out = m.apply(v, toks)
+    assert out.shape == (2, 8, 64)
+
+
+def test_transformer_causality():
+    cfg = TransformerConfig(vocab_size=64, num_layers=1, d_model=32,
+                            num_heads=2, head_dim=16, max_seq_len=16,
+                            dtype=jnp.float32)
+    m = Transformer(cfg)
+    rng = np.random.RandomState(0)
+    t1 = rng.randint(0, 64, (1, 8)).astype(np.int32)
+    t2 = t1.copy()
+    t2[0, -1] = (t2[0, -1] + 1) % 64  # change only the last token
+    v = m.init(jax.random.PRNGKey(0), jnp.asarray(t1))
+    o1 = m.apply(v, jnp.asarray(t1))
+    o2 = m.apply(v, jnp.asarray(t2))
+    # earlier positions must be unaffected by a future-token change
+    np.testing.assert_allclose(np.asarray(o1[:, :-1]), np.asarray(o2[:, :-1]),
+                               rtol=1e-5)
+    assert not np.allclose(np.asarray(o1[:, -1]), np.asarray(o2[:, -1]))
+
+
+@pytest.mark.integration
+def test_benchmark_machinery_smoke(hvd_world):
+    from horovod_tpu.benchmark import synthetic_resnet50_benchmark
+    r = synthetic_resnet50_benchmark(
+        batch_per_chip=2, num_warmup_batches=1, num_batches_per_iter=1,
+        num_iters=1, image_size=32, model_name="resnet18")
+    assert r.images_per_sec_total > 0
+    assert r.num_chips == 8
+
+
+@pytest.mark.integration
+def test_graft_entry_dryrun():
+    import importlib.util
+    spec = importlib.util.spec_from_file_location(
+        "__graft_entry__", "/root/repo/__graft_entry__.py")
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    mod.dryrun_multichip(8)
+    # entry() compile check on small shapes is covered by the driver; here
+    # just validate it returns a jittable fn + args
+    fn, args = mod.entry()
+    assert callable(fn) and len(args) == 2
