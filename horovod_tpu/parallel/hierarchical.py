@@ -1,0 +1,41 @@
+"""Hierarchical allreduce expressed in shard_map.
+
+Reference: NCCLHierarchicalAllreduce
+(/root/reference/horovod/common/ops/nccl_operations.cc:178-372) — NCCL
+ReduceScatter within the node, MPI allreduce across nodes on the scattered
+shards, NCCL Allgather back. On TPU the same bandwidth-optimal decomposition
+is three XLA collectives over two mesh axes: the inner (ICI) axis carries
+the scatter/gather, the outer (DCN) axis carries the cross-slice reduction
+on 1/inner_size of the data.
+
+XLA often produces this decomposition itself for a plain two-axis psum; the
+explicit form exists for when the schedule matters (overlap tuning) and as
+the building block for the autotuner's hierarchy on/off knob (reference
+parameter_manager.h:38 HierarchicalAllreduce toggle).
+"""
+
+
+def hierarchical_allreduce(x, inner_axis: str, outer_axis: str,
+                           scatter_dimension: int = 0):
+    """Sum ``x`` over both axes: reduce_scatter(inner) -> psum(outer) ->
+    all_gather(inner). Equivalent to psum over (inner, outer) but moves only
+    1/inner_size of the bytes over the outer (slow) links.
+
+    ``x``'s ``scatter_dimension`` must be divisible by the inner axis size.
+    Use inside shard_map over a mesh containing both axes.
+    """
+    import jax
+
+    scattered = jax.lax.psum_scatter(
+        x, inner_axis, scatter_dimension=scatter_dimension, tiled=True)
+    reduced = jax.lax.psum(scattered, outer_axis)
+    return jax.lax.all_gather(
+        reduced, inner_axis, axis=scatter_dimension, tiled=True)
+
+
+def hierarchical_pmean(x, inner_axis: str, outer_axis: str,
+                       scatter_dimension: int = 0):
+    import jax
+    n = jax.lax.axis_size(inner_axis) * jax.lax.axis_size(outer_axis)
+    return hierarchical_allreduce(
+        x, inner_axis, outer_axis, scatter_dimension) / n

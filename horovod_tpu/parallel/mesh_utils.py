@@ -1,0 +1,90 @@
+"""Training-mesh construction.
+
+The reference's GLOBAL/LOCAL/CROSS communicator triple
+(/root/reference/horovod/common/common.h:111) generalizes on TPU to an
+N-dimensional device mesh whose axis order encodes interconnect locality:
+the **last** axes map to adjacent devices (ICI neighbors), the **first** axis
+crosses slices (DCN). Collectives over trailing axes ride ICI; leading axes
+ride DCN — so put tp/sp (latency-critical, per-layer) innermost and dp
+(once-per-step gradient reduction) outermost, the standard scaling recipe.
+"""
+
+import dataclasses
+from typing import Optional, Tuple
+
+import numpy as np
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshConfig:
+    """Sizes of each parallelism axis; -1 on dp means "absorb the rest"."""
+    dp: int = -1      # data parallel (gradient allreduce, DCN-tolerant)
+    fsdp: int = 1     # sharded params/optimizer (ZeRO-3 style)
+    pp: int = 1       # pipeline stages
+    ep: int = 1       # expert parallel
+    sp: int = 1       # sequence/context parallel (ring attention)
+    tp: int = 1       # tensor parallel (innermost, ICI-adjacent)
+
+
+AXIS_ORDER = ("dp", "fsdp", "pp", "ep", "sp", "tp")
+
+
+def make_training_mesh(config: MeshConfig = MeshConfig(),
+                       devices=None):
+    """Build a Mesh with axes ('dp','fsdp','pp','ep','sp','tp').
+
+    Axes of size 1 are kept (harmless to XLA, simplifies downstream specs).
+    """
+    import jax
+    from jax.sharding import Mesh
+
+    if devices is None:
+        devices = jax.devices()
+    n = len(devices)
+    sizes = {a: getattr(config, a) for a in AXIS_ORDER}
+    fixed = int(np.prod([s for a, s in sizes.items() if a != "dp" and s > 0]))
+    if sizes["dp"] == -1:
+        if n % fixed != 0:
+            raise ValueError(
+                f"{n} devices not divisible by non-dp axes product {fixed}")
+        sizes["dp"] = n // fixed
+    total = int(np.prod(list(sizes.values())))
+    if total != n:
+        raise ValueError(
+            f"mesh sizes {sizes} use {total} devices but {n} are available")
+    arr = np.array(devices).reshape([sizes[a] for a in AXIS_ORDER])
+    return Mesh(arr, AXIS_ORDER)
+
+
+# Logical-axis -> mesh-axis rules for the transformer in models/transformer.py
+# (flax nn.with_logical_partitioning names). 'embed' stays replicated across
+# tp (activations shard over it only in sequence-parallel regions); params
+# additionally shard over fsdp on their largest axis.
+TRANSFORMER_RULES: Tuple[Tuple[str, Optional[str]], ...] = (
+    ("vocab", "tp"),
+    ("heads", "tp"),
+    ("mlp", "tp"),
+    ("embed", "fsdp"),
+    ("kv", None),
+)
+
+
+def batch_spec():
+    """PartitionSpec for a (batch, ...) input: batch shards over dp and fsdp
+    (fsdp acts as extra data parallelism for the forward pass)."""
+    from jax.sharding import PartitionSpec as P
+    return P(("dp", "fsdp"))
+
+
+def param_shardings(mesh, abstract_variables, rules=TRANSFORMER_RULES):
+    """NamedShardings for a flax variables pytree annotated with
+    with_logical_partitioning."""
+    import jax
+    from flax import linen as nn
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    logical = nn.get_partition_spec(abstract_variables)
+    mesh_specs = nn.logical_to_mesh(logical, rules)
+    return jax.tree_util.tree_map(
+        lambda spec: NamedSharding(mesh, spec), mesh_specs,
+        is_leaf=lambda x: isinstance(x, P))

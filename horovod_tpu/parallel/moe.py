@@ -1,0 +1,104 @@
+"""Expert parallelism (Mixture-of-Experts) over the 'ep' mesh axis.
+
+Absent from the reference (SURVEY.md §2.3). Switch-Transformer-style top-1
+routing with capacity, dispatched between devices by a single pair of
+all_to_alls — the canonical TPU MoE layout: experts shard over 'ep', each
+device computes only its experts, and token movement is one all_to_all each
+way (ICI-friendly; the dispatch/combine einsums land on the MXU).
+
+Static shapes throughout (capacity fixed at trace time); overflowing tokens
+are dropped and their outputs fall back to zero (residual connections carry
+them), the standard capacity-factor semantics.
+"""
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def route_top1(gate_logits, capacity: int) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Top-1 router (per device group).
+
+    Args:
+      gate_logits: (T, E) router scores for T tokens over E experts.
+      capacity: max tokens per expert held by this group.
+    Returns:
+      dispatch: (T, E, C) one-hot dispatch mask.
+      combine:  (T, E, C) combine weights (gate prob on the dispatch slot).
+    """
+    T, E = gate_logits.shape
+    probs = jax.nn.softmax(gate_logits.astype(jnp.float32), axis=-1)
+    expert = jnp.argmax(probs, axis=-1)                    # (T,)
+    onehot = jax.nn.one_hot(expert, E, dtype=jnp.float32)  # (T, E)
+    # position of each token within its expert's queue
+    pos = jnp.cumsum(onehot, axis=0) * onehot - 1.0        # (T, E)
+    keep = (pos >= 0) & (pos < capacity)
+    pos = jnp.clip(pos, 0, capacity - 1).astype(jnp.int32)
+    dispatch = (jax.nn.one_hot(pos, capacity, dtype=jnp.float32)
+                * (onehot * keep)[..., None])              # (T, E, C)
+    gate = jnp.sum(probs * onehot, axis=-1)                # (T,)
+    combine = dispatch * gate[:, None, None]
+    return dispatch, combine
+
+
+def moe_mlp(x, gate_w, w_in, w_out, axis_name: str,
+            capacity_factor: float = 1.25, act=jax.nn.gelu):
+    """MoE FFN to use INSIDE shard_map over ``axis_name``.
+
+    Args:
+      x: (T_local, D) this device's tokens (flatten batch x seq first).
+      gate_w: (D, E_total) router weights (replicated).
+      w_in: (E_local, D, Hd) this device's expert up-projections.
+      w_out: (E_local, Hd, D) this device's expert down-projections.
+    Returns (T_local, D).
+    """
+    n = jax.lax.axis_size(axis_name)
+    T, D = x.shape
+    E_local = w_in.shape[0]
+    E = E_local * n
+    capacity = max(1, int(capacity_factor * T / E))
+
+    logits = x @ gate_w.astype(x.dtype)                     # (T, E)
+    dispatch, combine = route_top1(logits, capacity)
+
+    xf = x.astype(jnp.float32)
+    # local expert buffers: (E, C, D)
+    buf = jnp.einsum("td,tec->ecd", xf, dispatch)
+    # exchange: each device keeps rows for ITS experts from every peer:
+    # (E, C, D) -> (E_local, n*C, D)
+    buf = jax.lax.all_to_all(buf, axis_name, split_axis=0, concat_axis=1,
+                             tiled=True)
+    h = jnp.einsum("ecd,edh->ech", buf.astype(x.dtype),
+                   w_in.astype(x.dtype))
+    h = act(h)
+    out = jnp.einsum("ech,ehd->ecd", h, w_out.astype(x.dtype))
+    # route back: (E_local, n*C, D) -> (E, C, D)
+    out = jax.lax.all_to_all(out.astype(jnp.float32), axis_name,
+                             split_axis=1, concat_axis=0, tiled=True)
+    y = jnp.einsum("ecd,tec->td", out, combine)
+    return y.astype(x.dtype)
+
+
+class MoEMlp:
+    """Parameter container + init for :func:`moe_mlp` (kept framework-thin;
+    flax integration wraps this in a Module when needed)."""
+
+    def __init__(self, d_model: int, hidden: int, num_experts: int):
+        self.d_model = d_model
+        self.hidden = hidden
+        self.num_experts = num_experts
+
+    def init(self, rng):
+        k1, k2, k3 = jax.random.split(rng, 3)
+        s = 0.02
+        return {
+            "gate_w": jax.random.normal(
+                k1, (self.d_model, self.num_experts), jnp.float32) * s,
+            "w_in": jax.random.normal(
+                k2, (self.num_experts, self.d_model, self.hidden),
+                jnp.float32) * s,
+            "w_out": jax.random.normal(
+                k3, (self.num_experts, self.hidden, self.d_model),
+                jnp.float32) * s,
+        }

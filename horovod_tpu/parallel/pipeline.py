@@ -1,0 +1,80 @@
+"""Pipeline parallelism over the 'pp' mesh axis.
+
+Absent from the reference (SURVEY.md §2.3). GPipe-style microbatch schedule
+expressed as a ``lax.scan`` over time steps with ``ppermute`` moving
+activations to the next stage each step — the canonical TPU pipelining
+pattern (activations hop one ICI neighbor per step; XLA overlaps the
+permute with stage compute). Backward works by reverse-mode AD through the
+scan: the reversed ppermute carries gradients stage-to-stage in the drain
+order, so no hand-written backward schedule is needed.
+
+Bubble fraction is (P-1)/(M+P-1) for P stages and M microbatches — pick
+M >= 4*P for >80% utilization.
+"""
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+
+
+def pipeline_shard_fn(stage_fn: Callable, stage_params, microbatches,
+                      axis_name: str = "pp"):
+    """Body to use INSIDE shard_map over ``axis_name``.
+
+    Args:
+      stage_fn: (params, x) -> y, the per-stage computation. All stages share
+        this structure (e.g. a stack of identical decoder layers).
+      stage_params: this device's stage parameters (already sharded by the
+        surrounding shard_map in_specs).
+      microbatches: (M, mb, ...) full input, replicated across stages (only
+        stage 0 consumes it).
+    Returns (M, mb, ...) final-stage outputs, replicated across stages.
+    """
+    P = jax.lax.axis_size(axis_name)
+    idx = jax.lax.axis_index(axis_name)
+    M = microbatches.shape[0]
+    T = M + P - 1
+    mb_shape = microbatches.shape[1:]
+    perm_fwd = [(p, p + 1) for p in range(P - 1)]
+
+    def step(carry, t):
+        incoming = carry  # activation arriving at my stage this tick
+        # stage 0 injects microbatch t (clamped; masked off after t >= M)
+        inject = jax.lax.dynamic_index_in_dim(
+            microbatches, jnp.minimum(t, M - 1), axis=0, keepdims=False)
+        x = jnp.where(idx == 0, inject, incoming)
+        y = stage_fn(stage_params, x)
+        # last stage's output for microbatch (t - P + 1); other stages pass on
+        out_slot = jnp.where(idx == P - 1, y, jnp.zeros_like(y))
+        nxt = jax.lax.ppermute(y, axis_name, perm_fwd)
+        return nxt, out_slot
+
+    init = jnp.zeros(mb_shape, microbatches.dtype)
+    _, outs = jax.lax.scan(step, init, jnp.arange(T))  # (T, mb, ...)
+    # replicate the last stage's results to every stage so downstream code
+    # (loss on stage 0, metrics) sees them; zeros elsewhere make psum exact
+    outs = jax.lax.psum(outs, axis_name)
+    return jax.lax.slice_in_dim(outs, P - 1, T, axis=0)  # (M, mb, ...)
+
+
+def pipeline_apply(stage_fn: Callable, stacked_params, microbatches, mesh,
+                   axis_name: str = "pp"):
+    """Convenience wrapper: shard_map over ``axis_name`` with stage params
+    stacked on a leading axis of size P (params[p] = stage p).
+
+    ``microbatches``: (M, mb, ...) global input. Returns (M, mb, ...).
+    """
+    from jax.sharding import PartitionSpec as Spec
+
+    def body(params, mb):
+        # shard_map leaves a leading axis of size 1 on the stacked params
+        params = jax.tree_util.tree_map(lambda a: a[0], params)
+        return pipeline_shard_fn(stage_fn, params, mb, axis_name)
+
+    in_specs = (jax.tree_util.tree_map(lambda _: Spec(axis_name),
+                                       stacked_params),
+                Spec())
+    return jax.shard_map(
+        body, mesh=mesh, in_specs=in_specs, out_specs=Spec(),
+        check_vma=False)(stacked_params, microbatches)

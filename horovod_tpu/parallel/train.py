@@ -1,0 +1,115 @@
+"""Composed distributed training step for the transformer.
+
+This is the TPU-native "DistributedOptimizer end-to-end": one jitted SPMD
+program over a (dp, fsdp, pp, ep, sp, tp) mesh where
+
+* parameters shard by their logical axes (tp/fsdp) — pjit auto mode;
+* the batch shards over (dp, fsdp), the sequence over sp;
+* attention runs ring (or Ulysses) context-parallel via a *nested* manual
+  shard_map over just the 'sp' axis (axis_names={'sp'}), while dp/fsdp/tp
+  stay in XLA's automatic sharding propagation — so the gradient allreduce,
+  tensor-parallel collectives, and the ring ppermutes all come out of one
+  compilation;
+* gradients need no explicit reduction (auto mode supplies them globally
+  correct; DistributedOptimizer mode 2).
+"""
+
+import dataclasses
+from typing import Optional
+
+import numpy as np
+
+
+def sharded_attention(mesh, kind: str = "ring", causal: bool = True):
+    """Build a TransformerConfig.attention_fn running context-parallel over
+    the mesh's 'sp' axis, nested inside auto dp/fsdp/tp sharding."""
+    import jax
+    from jax.sharding import PartitionSpec as P
+
+    from .ring_attention import ring_attention
+    from .ulysses import ulysses_attention
+
+    if mesh.shape.get("sp", 1) == 1:
+        return None  # fall back to the model's default full attention
+
+    def fn(q, k, v, mask, dtype):
+        del mask  # global causal masking computed from ring positions
+
+        def inner(ql, kl, vl):
+            if kind == "ring":
+                return ring_attention(ql, kl, vl, "sp", causal=causal,
+                                      out_dtype=dtype)
+            return ulysses_attention(ql, kl, vl, "sp", causal=causal,
+                                     out_dtype=dtype)
+
+        return jax.shard_map(
+            inner, mesh=mesh, in_specs=P(None, "sp"),
+            out_specs=P(None, "sp"), axis_names={"sp"})(q, k, v)
+    return fn
+
+
+@dataclasses.dataclass
+class TrainStepBundle:
+    step: object            # jitted (params, opt_state, tokens, targets) ->
+    #                         (params, opt_state, loss)
+    params: object
+    opt_state: object
+    batch_sharding: object
+    mesh: object
+
+
+def make_transformer_train_step(cfg, mesh, optimizer=None,
+                                attention_kind: str = "ring",
+                                rules=None) -> TrainStepBundle:
+    """Build model + sharded params + jitted train step over ``mesh``.
+
+    ``cfg``: models.transformer.TransformerConfig (attention_fn is replaced
+    with the sp-parallel one when the mesh has sp > 1).
+    """
+    import jax
+    import jax.numpy as jnp
+    import optax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    import horovod_tpu as hvd
+    from horovod_tpu.models import Transformer
+    from .mesh_utils import TRANSFORMER_RULES, param_shardings
+
+    rules = rules or TRANSFORMER_RULES
+    attn = sharded_attention(mesh, kind=attention_kind)
+    cfg = dataclasses.replace(cfg, attention_fn=attn)
+    model = Transformer(cfg)
+
+    optimizer = optimizer or optax.adamw(1e-3)
+    opt = hvd.DistributedOptimizer(optimizer)
+
+    sp = mesh.shape.get("sp", 1)
+    S = cfg.max_seq_len
+    if S % max(sp, 1) != 0:
+        raise ValueError(f"seq len {S} not divisible by sp={sp}")
+    tok0 = jnp.zeros((1, S), jnp.int32)
+
+    abstract = jax.eval_shape(
+        lambda: model.init(jax.random.PRNGKey(0), tok0))
+    shardings = param_shardings(mesh, abstract, rules)
+    variables = jax.jit(
+        lambda: model.init(jax.random.PRNGKey(0), tok0),
+        out_shardings=shardings)()
+    params = variables["params"]
+    opt_state = opt.init(params)
+
+    batch_sharding = NamedSharding(mesh, P(("dp", "fsdp"), "sp"))
+
+    def loss_fn(p, toks, tgts):
+        logits = model.apply({"params": p}, toks)
+        return optax.softmax_cross_entropy_with_integer_labels(
+            logits, tgts).mean()
+
+    def _step(p, s, toks, tgts):
+        loss, grads = jax.value_and_grad(loss_fn)(p, toks, tgts)
+        updates, s = opt.update(grads, s, p)
+        return optax.apply_updates(p, updates), s, loss
+
+    step = jax.jit(_step, donate_argnums=(0, 1))
+    return TrainStepBundle(step=step, params=params, opt_state=opt_state,
+                           batch_sharding=batch_sharding, mesh=mesh)
