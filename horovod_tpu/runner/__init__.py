@@ -1,0 +1,21 @@
+"""Launcher layer (L7): the TPU-native ``horovodrun``.
+
+Reference: /root/reference/horovod/runner/ — ``horovodrun`` console script
+(launch.py:711 run_commandline), programmatic ``horovod.run()``
+(runner/__init__.py:89), host/slot assignment (common/util/hosts.py:106-155),
+HTTP KV rendezvous (http/http_server.py), threaded ssh execution
+(gloo_run.py:112-261).
+
+TPU-native differences: there is exactly one data-plane backend (XLA over
+ICI/DCN), so the reference's gloo/mpi/jsrun controller selection collapses to
+one launch path; rendezvous doubles as (a) the JAX distributed coordinator
+address contract and (b) an HTTP KV store for run()-results, barriers and
+elastic membership. One process per host is the default (TPU
+single-controller-per-host model) instead of one per accelerator.
+"""
+
+from .api import run, run_func_result_scope  # noqa: F401
+from .hosts import (  # noqa: F401
+    HostInfo, SlotInfo, parse_hosts, parse_hostfile, get_host_assignments,
+)
+from .launch import main, run_commandline  # noqa: F401

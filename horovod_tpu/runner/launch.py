@@ -1,0 +1,218 @@
+"""``horovodrun-tpu`` CLI.
+
+Reference: /root/reference/horovod/runner/launch.py — arg groups (tuning,
+timeline, stall, autotune, elastic) that write env vars (launch.py:216-482),
+ssh reachability precheck (launch.py:55-108), static vs elastic dispatch
+(launch.py:484-708). The reference's gloo/mpi/jsrun controller selection
+(run_controller, launch.py:629-659) collapses here: the data plane is always
+XLA, so there is one launch path with static and elastic variants.
+"""
+
+import argparse
+import os
+import random
+import socket
+import subprocess
+import sys
+from typing import List
+
+from . import config_parser
+from .exec_run import is_local_host, launch_workers
+from .hosts import HostInfo, get_host_assignments, parse_hostfile, parse_hosts
+from .rendezvous import RendezvousServer
+
+
+def free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("0.0.0.0", 0))
+        return s.getsockname()[1]
+
+
+def check_ssh(hostnames: List[str], timeout: float = 10.0) -> List[str]:
+    """Return the subset of non-local hosts unreachable over passwordless ssh,
+    probed concurrently (reference launch.py:55-108
+    _check_all_hosts_ssh_successful uses a thread per host)."""
+    import concurrent.futures
+
+    def probe(h: str) -> bool:
+        try:
+            r = subprocess.run(
+                ["ssh", "-o", "BatchMode=yes", "-o", "StrictHostKeyChecking=no",
+                 "-o", f"ConnectTimeout={int(timeout)}", h, "true"],
+                capture_output=True, timeout=timeout + 5)
+            return r.returncode == 0
+        except (subprocess.TimeoutExpired, FileNotFoundError):
+            return False
+
+    remote = [h for h in set(hostnames) if not is_local_host(h)]
+    if not remote:
+        return []
+    with concurrent.futures.ThreadPoolExecutor(
+            max_workers=min(32, len(remote))) as ex:
+        ok = list(ex.map(probe, remote))
+    return [h for h, good in zip(remote, ok) if not good]
+
+
+def make_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="horovodrun-tpu",
+        description="Launch a horovod_tpu distributed job.")
+    p.add_argument("-v", "--version", action="store_true")
+    p.add_argument("-np", "--num-proc", type=int, dest="np", default=None,
+                   help="Total number of worker processes (default: one per "
+                        "host; TPU chips are addressed via meshes, not "
+                        "processes).")
+    p.add_argument("--verbose", action="store_true")
+    p.add_argument("--config-file", dest="config_file", default=None)
+
+    g = p.add_argument_group("host arguments")
+    g.add_argument("-H", "--hosts", dest="hosts", default=None,
+                   help='Comma-separated host:slots list, e.g. "h1:1,h2:1".')
+    g.add_argument("--hostfile", dest="hostfile", default=None)
+    g.add_argument("--start-timeout", dest="start_timeout", type=float,
+                   default=None)
+    g.add_argument("--output-filename", dest="output_filename", default=None,
+                   help="Directory for per-rank log files instead of "
+                        "interleaved stdout.")
+    g.add_argument("--disable-ssh-check", action="store_true",
+                   dest="disable_ssh_check")
+
+    g = p.add_argument_group("tuning arguments")
+    g.add_argument("--fusion-threshold-mb", type=int, default=None,
+                   dest="fusion_threshold_mb")
+    g.add_argument("--cycle-time-ms", type=float, default=None,
+                   dest="cycle_time_ms")
+    g.add_argument("--cache-capacity", type=int, default=None,
+                   dest="cache_capacity")
+    g.add_argument("--check-consistency", action="store_true",
+                   dest="check_consistency",
+                   help="Cross-process name/shape/dtype validation of eager "
+                        "collectives (reference controller.cc:378-611).")
+
+    g = p.add_argument_group("timeline arguments")
+    g.add_argument("--timeline-filename", default=None,
+                   dest="timeline_filename")
+    g.add_argument("--timeline-mark-cycles", action="store_true",
+                   dest="timeline_mark_cycles")
+
+    g = p.add_argument_group("stall check arguments")
+    g.add_argument("--no-stall-check", action="store_true",
+                   dest="no_stall_check")
+    g.add_argument("--stall-check-warning-time-seconds", type=float,
+                   default=None, dest="stall_check_warning_time_seconds")
+    g.add_argument("--stall-check-shutdown-time-seconds", type=float,
+                   default=None, dest="stall_check_shutdown_time_seconds")
+
+    g = p.add_argument_group("autotune arguments")
+    g.add_argument("--autotune", action="store_true", dest="autotune")
+    g.add_argument("--autotune-log-file", default=None,
+                   dest="autotune_log_file")
+    g.add_argument("--autotune-warmup-samples", type=int, default=None,
+                   dest="autotune_warmup_samples")
+    g.add_argument("--autotune-steps-per-sample", type=int, default=None,
+                   dest="autotune_steps_per_sample")
+    g.add_argument("--autotune-bayes-opt-max-samples", type=int, default=None,
+                   dest="autotune_bayes_opt_max_samples")
+
+    g = p.add_argument_group("elastic arguments")
+    g.add_argument("--min-np", type=int, default=None, dest="min_np")
+    g.add_argument("--max-np", type=int, default=None, dest="max_np")
+    g.add_argument("--host-discovery-script", default=None,
+                   dest="host_discovery_script")
+    g.add_argument("--slots", type=int, default=None, dest="slots",
+                   help="Slots per discovered host in elastic mode.")
+    g.add_argument("--elastic-timeout", type=float, default=None,
+                   dest="elastic_timeout")
+    g.add_argument("--reset-limit", type=int, default=None, dest="reset_limit")
+
+    p.add_argument("--verbose-log-level", default=None,
+                   dest="verbose_log_level")
+    p.add_argument("command", nargs=argparse.REMAINDER,
+                   help="Command to run on every worker.")
+    return p
+
+
+def parse_args(argv=None) -> argparse.Namespace:
+    args = make_parser().parse_args(argv)
+    if args.config_file:
+        config_parser.apply_config_file(
+            args, config_parser.load_config_file(args.config_file))
+    if args.command and args.command[0] == "--":
+        args.command = args.command[1:]
+    return args
+
+
+def _resolve_hosts(args) -> List[HostInfo]:
+    if args.hosts and args.hostfile:
+        raise ValueError("specify either --hosts or --hostfile, not both")
+    if args.hosts:
+        return parse_hosts(args.hosts)
+    if args.hostfile:
+        return parse_hostfile(args.hostfile)
+    return [HostInfo("localhost", args.np or 1)]
+
+
+def _run_static(args) -> int:
+    hosts = _resolve_hosts(args)
+    np = args.np or sum(h.slots for h in hosts)
+    if not args.disable_ssh_check:
+        bad = check_ssh([h.hostname for h in hosts])
+        if bad:
+            raise RuntimeError(
+                f"hosts not reachable over passwordless ssh: {sorted(bad)}")
+    slots, size = get_host_assignments(hosts, np)
+
+    rendezvous = RendezvousServer(verbose=args.verbose)
+    rendezvous.start()
+    rendezvous.init(slots)
+    try:
+        all_local = all(is_local_host(s.hostname) for s in slots)
+        coord_host = "127.0.0.1" if all_local else slots[0].hostname
+        coordinator_addr = f"{coord_host}:{free_port()}"
+        base_env = config_parser.set_env_from_args(dict(os.environ), args)
+        rdv_host = "127.0.0.1" if all_local else socket.gethostname()
+        codes = launch_workers(
+            args.command, slots, coordinator_addr,
+            rendezvous_addr=rdv_host, rendezvous_port=rendezvous.port,
+            output_dir=args.output_filename, base_env=base_env)
+    finally:
+        rendezvous.stop()
+    failed = [(r, c) for r, c in enumerate(codes) if c != 0]
+    if failed:
+        sys.stderr.write(f"horovodrun-tpu: ranks failed: {failed}\n")
+        return failed[0][1] or 1
+    return 0
+
+
+def _run_elastic(args) -> int:
+    try:
+        from ..elastic.launcher import launch_elastic
+    except ImportError as e:
+        raise RuntimeError(
+            "elastic launch requires the horovod_tpu.elastic package; "
+            f"it failed to import: {e}") from e
+    return launch_elastic(args)
+
+
+def run_commandline(argv=None) -> int:
+    """Entry point (reference launch.py:711 run_commandline → _run:686)."""
+    args = parse_args(argv)
+    if args.version:
+        from .. import __version__
+        print(__version__)
+        return 0
+    if not args.command:
+        make_parser().print_usage()
+        return 2
+    random.seed()
+    if args.host_discovery_script or (args.min_np is not None):
+        return _run_elastic(args)
+    return _run_static(args)
+
+
+def main():
+    sys.exit(run_commandline())
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,202 @@
+"""HTTP KV rendezvous store.
+
+Reference: /root/reference/horovod/runner/http/http_server.py (threaded KV
+store serving PUT/GET ``/scope/key``; RendezvousServer publishing slot info;
+ElasticRendezvousHandler serving live ``rank_and_size`` lookups;
+KVStoreServer carrying run()-function results) and the worker-side client in
+common/gloo/http_store.{h,cc} (set/get/wait over HTTP).
+
+horovod_tpu keeps the same wire contract (plain HTTP, value = raw bytes) so
+the architecture transfers: the launcher owns the store; workers and the
+elastic driver read/write scoped keys. The JAX distributed coordinator handles
+the *data-plane* rendezvous; this store is the *host-plane* side channel.
+"""
+
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Callable, Dict, Optional, Tuple
+from urllib.request import Request, urlopen
+from urllib.error import HTTPError, URLError
+
+
+class _KVHandler(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+
+    def log_message(self, fmt, *args):  # silence default stderr logging
+        if getattr(self.server, "verbose", False):
+            super().log_message(fmt, *args)
+
+    def _split(self) -> Tuple[str, str]:
+        parts = self.path.strip("/").split("/", 1)
+        scope = parts[0] if parts else ""
+        key = parts[1] if len(parts) > 1 else ""
+        return scope, key
+
+    def do_PUT(self):
+        scope, key = self._split()
+        length = int(self.headers.get("Content-Length", 0))
+        value = self.rfile.read(length)
+        self.server.store_put(scope, key, value)
+        self.send_response(200)
+        self.send_header("Content-Length", "0")
+        self.end_headers()
+
+    def do_GET(self):
+        scope, key = self._split()
+        value = self.server.store_get(scope, key)
+        if value is None:
+            self.send_response(404)
+            self.send_header("Content-Length", "0")
+            self.end_headers()
+            return
+        self.send_response(200)
+        self.send_header("Content-Length", str(len(value)))
+        self.end_headers()
+        self.wfile.write(value)
+
+    def do_DELETE(self):
+        scope, key = self._split()
+        self.server.store_delete(scope, key)
+        self.send_response(200)
+        self.send_header("Content-Length", "0")
+        self.end_headers()
+
+
+class KVStoreServer:
+    """Launcher-side threaded KV store (reference http_server.py:42-170).
+
+    ``handlers``: optional dict mapping a scope name to a callable
+    ``(key) -> Optional[bytes]`` consulted on GET before the static store —
+    this is how the elastic driver serves live ``rank_and_size`` lookups
+    (reference runner/elastic/rendezvous.py:29-60).
+    """
+
+    def __init__(self, port: int = 0, verbose: bool = False,
+                 handlers: Optional[Dict[str, Callable]] = None):
+        self._data: Dict[Tuple[str, str], bytes] = {}
+        self._lock = threading.Lock()
+        self._requested_port = port
+        self._verbose = verbose
+        self._httpd: Optional[ThreadingHTTPServer] = None
+        self._handlers = dict(handlers or {})
+        self._thread: Optional[threading.Thread] = None
+
+    # -- server lifecycle ---------------------------------------------------
+    @property
+    def port(self) -> int:
+        if self._httpd is None:
+            raise RuntimeError("KVStoreServer not started")
+        return self._httpd.server_address[1]
+
+    def start(self) -> int:
+        # Socket is bound here, not in __init__, so constructing a server is
+        # side-effect free and a failed run can retry the same fixed port.
+        self._httpd = ThreadingHTTPServer(
+            ("0.0.0.0", self._requested_port), _KVHandler)
+        self._httpd.verbose = self._verbose
+        self._httpd.store_put = self._put
+        self._httpd.store_get = self._get
+        self._httpd.store_delete = self._delete
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, name="hvd-kvstore", daemon=True)
+        self._thread.start()
+        return self.port
+
+    def stop(self):
+        if self._httpd is None:
+            return
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        self._httpd = None
+        if self._thread:
+            self._thread.join(timeout=5)
+
+    def add_handler(self, scope: str, fn: Callable):
+        with self._lock:
+            self._handlers[scope] = fn
+
+    # -- store --------------------------------------------------------------
+    def _put(self, scope, key, value):
+        with self._lock:
+            self._data[(scope, key)] = value
+
+    def _get(self, scope, key):
+        with self._lock:
+            handler = self._handlers.get(scope)
+        if handler is not None:
+            out = handler(key)
+            if out is not None:
+                return out
+        with self._lock:
+            return self._data.get((scope, key))
+
+    def _delete(self, scope, key):
+        with self._lock:
+            self._data.pop((scope, key), None)
+
+    # convenience for in-process use (launcher side)
+    def put(self, scope: str, key: str, value: bytes):
+        self._put(scope, key, value)
+
+    def get(self, scope: str, key: str) -> Optional[bytes]:
+        return self._get(scope, key)
+
+
+class RendezvousServer(KVStoreServer):
+    """KV store that additionally publishes the slot plan
+    (reference http_server.py:175-242 RendezvousServer.init)."""
+
+    def init(self, slot_infos) -> int:
+        """Publish per-slot rank info under the ``rank_and_size`` scope keyed
+        by ``hostname:local_rank`` (the lookup the reference's elastic workers
+        do, gloo/gloo_context.cc:157-170)."""
+        for s in slot_infos:
+            payload = (f"{s.rank},{s.size},{s.local_rank},{s.local_size},"
+                       f"{s.cross_rank},{s.cross_size}").encode()
+            self.put("rank_and_size", f"{s.hostname}:{s.local_rank}", payload)
+        return self.port
+
+
+class KVStoreClient:
+    """Worker-side client (reference common/gloo/http_store.h:34-75:
+    set / get / wait semantics over HTTP)."""
+
+    def __init__(self, addr: str, port: int, timeout: float = 30.0):
+        self._base = f"http://{addr}:{port}"
+        self._timeout = timeout
+
+    def put(self, scope: str, key: str, value: bytes):
+        req = Request(f"{self._base}/{scope}/{key}", data=value, method="PUT")
+        with urlopen(req, timeout=self._timeout):
+            pass
+
+    def get(self, scope: str, key: str) -> Optional[bytes]:
+        try:
+            with urlopen(f"{self._base}/{scope}/{key}",
+                         timeout=self._timeout) as resp:
+                return resp.read()
+        except HTTPError as e:
+            if e.code == 404:
+                return None
+            raise
+
+    def wait(self, scope: str, key: str, timeout: float = 60.0,
+             poll_interval: float = 0.1) -> bytes:
+        deadline = time.monotonic() + timeout
+        while True:
+            try:
+                value = self.get(scope, key)
+            except URLError:
+                value = None
+            if value is not None:
+                return value
+            if time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"timed out waiting for {scope}/{key} on {self._base}")
+            time.sleep(poll_interval)
+
+    def delete(self, scope: str, key: str):
+        req = Request(f"{self._base}/{scope}/{key}", method="DELETE")
+        with urlopen(req, timeout=self._timeout):
+            pass

@@ -1,0 +1,33 @@
+"""Worker stub for the programmatic ``run()`` API.
+
+Reference: /root/reference/horovod/runner/run_task.py — fetches the pickled
+function from the launcher's KV store, executes it, posts the result back.
+"""
+
+import os
+import pickle
+import sys
+import traceback
+
+
+def main() -> int:
+    addr = os.environ["HVD_TPU_RENDEZVOUS_ADDR"]
+    port = int(os.environ["HVD_TPU_RENDEZVOUS_PORT"])
+    rank = int(os.environ.get("HVD_TPU_RANK", "0"))
+
+    from .rendezvous import KVStoreClient
+    client = KVStoreClient(addr, port)
+    fn, args, kwargs = pickle.loads(client.wait("run_func", "func"))
+    try:
+        value = fn(*args, **kwargs)
+        payload = {"value": value, "error": None}
+        code = 0
+    except BaseException:
+        payload = {"value": None, "error": traceback.format_exc()}
+        code = 1
+    client.put("run_result", str(rank), pickle.dumps(payload))
+    return code
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,267 @@
+"""Launcher-layer unit tests.
+
+Mirrors the reference's mock-based launcher testing strategy
+(/root/reference/test/test_run.py, 41 tests: hostfile parsing, env
+construction, controller selection — no cluster needed) plus live KV-store
+and safe-exec coverage (test/test_service.py style).
+"""
+
+import os
+import pickle
+import subprocess
+import sys
+import textwrap
+import threading
+import time
+
+import pytest
+
+from horovod_tpu.runner import (HostInfo, get_host_assignments, parse_hostfile,
+                                parse_hosts)
+from horovod_tpu.runner import config_parser, launch
+from horovod_tpu.runner.exec_run import is_local_host, slot_env
+from horovod_tpu.runner.rendezvous import (KVStoreClient, KVStoreServer,
+                                           RendezvousServer)
+from horovod_tpu.runner.safe_exec import safe_exec
+
+
+# -- host parsing / assignment (reference test_run.py hosts tests) -----------
+def test_parse_hosts():
+    hosts = parse_hosts("h1:4,h2:2,h3")
+    assert hosts == [HostInfo("h1", 4), HostInfo("h2", 2), HostInfo("h3", 1)]
+
+
+def test_parse_hosts_rejects_garbage():
+    with pytest.raises(ValueError):
+        parse_hosts("h1:four")
+
+
+def test_parse_hostfile(tmp_path):
+    p = tmp_path / "hostfile"
+    p.write_text("# comment\nh1 slots=4\nh2:2\nh3\n")
+    assert parse_hostfile(str(p)) == [
+        HostInfo("h1", 4), HostInfo("h2", 2), HostInfo("h3", 1)]
+
+
+def test_host_assignments_ranks_and_cross():
+    slots, size = get_host_assignments(
+        [HostInfo("a", 2), HostInfo("b", 2)], 4)
+    assert size == 4
+    by_rank = {s.rank: s for s in slots}
+    assert [by_rank[r].hostname for r in range(4)] == ["a", "a", "b", "b"]
+    assert [by_rank[r].local_rank for r in range(4)] == [0, 1, 0, 1]
+    # cross rank indexes hosts sharing the local_rank
+    assert by_rank[0].cross_rank == 0 and by_rank[2].cross_rank == 1
+    assert all(s.cross_size == 2 for s in slots)
+    assert all(s.local_size == 2 for s in slots)
+
+
+def test_host_assignments_ragged():
+    slots, size = get_host_assignments(
+        [HostInfo("a", 2), HostInfo("b", 1)], 3)
+    by_rank = {s.rank: s for s in slots}
+    # local_rank 1 exists only on host a
+    assert by_rank[1].cross_size == 1 and by_rank[1].cross_rank == 0
+    assert by_rank[2].hostname == "b" and by_rank[2].local_size == 1
+
+
+def test_host_assignments_insufficient_slots():
+    with pytest.raises(ValueError):
+        get_host_assignments([HostInfo("a", 1)], 2)
+
+
+def test_host_assignments_max_np():
+    slots, size = get_host_assignments(
+        [HostInfo("a", 4), HostInfo("b", 4)], 2, max_np=6)
+    assert size == 6
+    assert sum(1 for s in slots if s.hostname == "a") == 4
+
+
+# -- env contract ------------------------------------------------------------
+def test_slot_env_contract():
+    slots, _ = get_host_assignments([HostInfo("localhost", 2)], 2)
+    env = slot_env(slots[1], "127.0.0.1:7777", "127.0.0.1", 8888,
+                   base_env={})
+    assert env["HVD_TPU_RANK"] == "1"
+    assert env["HVD_TPU_SIZE"] == "2"
+    assert env["HVD_TPU_LOCAL_RANK"] == "1"
+    assert env["HVD_TPU_COORDINATOR_ADDR"] == "127.0.0.1:7777"
+    assert env["HVD_TPU_RENDEZVOUS_PORT"] == "8888"
+
+
+def test_is_local_host():
+    assert is_local_host("localhost")
+    assert is_local_host("127.0.0.1")
+    assert not is_local_host("tpu-worker-7.example.com")
+
+
+# -- CLI arg -> env translation (reference config_parser tests) --------------
+def test_set_env_from_args():
+    args = launch.parse_args(
+        ["--fusion-threshold-mb", "32", "--timeline-filename", "/tmp/t.json",
+         "--autotune", "--check-consistency", "--", "python", "x.py"])
+    env = config_parser.set_env_from_args({}, args)
+    assert env["HVD_TPU_FUSION_THRESHOLD"] == str(32 * 1024 * 1024)
+    assert env["HVD_TPU_TIMELINE"] == "/tmp/t.json"
+    assert env["HVD_TPU_AUTOTUNE"] == "1"
+    assert env["HVD_TPU_CHECK_CONSISTENCY"] == "1"
+    assert args.command == ["python", "x.py"]
+
+
+def test_config_file_merge(tmp_path):
+    cfg = tmp_path / "cfg.yaml"
+    cfg.write_text(textwrap.dedent("""
+        autotune: true
+        timeline:
+          filename: /tmp/tl.json
+        stall_check:
+          warning_time_seconds: 10
+    """))
+    args = launch.parse_args(
+        ["--config-file", str(cfg), "python", "x.py"])
+    assert args.autotune is True
+    assert args.timeline_filename == "/tmp/tl.json"
+    assert args.stall_check_warning_time_seconds == 10
+
+
+def test_elastic_dispatch_detection(monkeypatch):
+    called = {}
+
+    def fake_elastic(args):
+        called["elastic"] = True
+        return 0
+
+    monkeypatch.setattr(launch, "_run_elastic", fake_elastic)
+    launch.run_commandline(
+        ["--host-discovery-script", "/bin/discover", "python", "x.py"])
+    assert called.get("elastic")
+
+
+# -- KV store ----------------------------------------------------------------
+def test_kvstore_put_get_wait_delete():
+    server = KVStoreServer()
+    port = server.start()
+    try:
+        client = KVStoreClient("127.0.0.1", port)
+        assert client.get("s", "missing") is None
+        client.put("s", "k", b"hello")
+        assert client.get("s", "k") == b"hello"
+
+        def delayed_put():
+            time.sleep(0.3)
+            client.put("s", "later", b"arrived")
+
+        t = threading.Thread(target=delayed_put)
+        t.start()
+        assert client.wait("s", "later", timeout=5) == b"arrived"
+        t.join()
+        client.delete("s", "k")
+        assert client.get("s", "k") is None
+        with pytest.raises(TimeoutError):
+            client.wait("s", "never", timeout=0.3)
+    finally:
+        server.stop()
+
+
+def test_rendezvous_publishes_rank_and_size():
+    slots, _ = get_host_assignments([HostInfo("nodeA", 2)], 2)
+    server = RendezvousServer()
+    port = server.start()
+    try:
+        server.init(slots)
+        client = KVStoreClient("127.0.0.1", port)
+        blob = client.get("rank_and_size", "nodeA:1")
+        rank, size, lr, ls, cr, cs = map(int, blob.decode().split(","))
+        assert (rank, size, lr, ls) == (1, 2, 1, 2)
+    finally:
+        server.stop()
+
+
+def test_kvstore_dynamic_handler():
+    server = KVStoreServer(handlers={"live": lambda k: f"dyn:{k}".encode()})
+    port = server.start()
+    try:
+        client = KVStoreClient("127.0.0.1", port)
+        assert client.get("live", "abc") == b"dyn:abc"
+    finally:
+        server.stop()
+
+
+# -- safe exec ---------------------------------------------------------------
+def test_safe_exec_captures_output(capfd):
+    code = safe_exec([sys.executable, "-c", "print('marker-xyz')"],
+                     stdout_prefix="[0]<stdout> ")
+    assert code == 0
+    out = capfd.readouterr().out
+    assert "[0]<stdout> marker-xyz" in out
+
+
+def test_safe_exec_kills_process_tree():
+    stop = threading.Event()
+    # child spawns a grandchild; both must die when stop fires
+    script = ("import subprocess,sys,time;"
+              "subprocess.Popen([sys.executable,'-c','import time;"
+              "time.sleep(60)']);time.sleep(60)")
+    result = {}
+
+    def target():
+        result["code"] = safe_exec([sys.executable, "-c", script],
+                                   stop_event=stop)
+
+    t = threading.Thread(target=target)
+    t.start()
+    time.sleep(0.8)
+    stop.set()
+    t.join(timeout=15)
+    assert not t.is_alive()
+    assert result["code"] != 0
+
+
+# -- end-to-end local launch (no jax needed in workers) ----------------------
+@pytest.mark.integration
+def test_cli_static_launch_end_to_end(tmp_path):
+    out = tmp_path / "logs"
+    script = tmp_path / "worker.py"
+    script.write_text(
+        "import os\n"
+        "print('rank', os.environ['HVD_TPU_RANK'],"
+        " 'of', os.environ['HVD_TPU_SIZE'])\n")
+    rc = launch.run_commandline(
+        ["-np", "2", "--output-filename", str(out), "--",
+         sys.executable, str(script)])
+    assert rc == 0
+    logs = sorted(p.name for p in out.iterdir())
+    assert logs == ["rank.0.log", "rank.1.log"]
+    assert "rank 0 of 2" in (out / "rank.0.log").read_text()
+
+
+@pytest.mark.integration
+def test_cli_propagates_failure(tmp_path):
+    rc = launch.run_commandline(
+        ["-np", "2", "--", sys.executable, "-c", "import sys; sys.exit(3)"])
+    assert rc == 3
+
+
+@pytest.mark.integration
+def test_programmatic_run_api():
+    from horovod_tpu.runner import run
+
+    def fn(mult):
+        import os
+        return int(os.environ["HVD_TPU_RANK"]) * mult
+
+    env = {k: v for k, v in os.environ.items() if k != "XLA_FLAGS"}
+    results = run(fn, args=(10,), np=2, env=env)
+    assert results == [0, 10]
+
+
+@pytest.mark.integration
+def test_programmatic_run_api_propagates_exception():
+    from horovod_tpu.runner import run
+
+    def fn():
+        raise ValueError("boom-unique")
+
+    env = {k: v for k, v in os.environ.items() if k != "XLA_FLAGS"}
+    with pytest.raises(RuntimeError, match="boom-unique"):
+        run(fn, np=2, env=env)
