@@ -59,4 +59,9 @@ def __getattr__(name):
     if name == "Compression":
         from .compression import Compression
         return Compression
+    if name == "elastic":
+        # NOT `from . import elastic`: the fromlist lookup re-enters this
+        # __getattr__ before sys.modules is populated -> infinite recursion.
+        import importlib
+        return importlib.import_module(".elastic", __name__)
     raise AttributeError(f"module 'horovod_tpu' has no attribute {name!r}")

@@ -1,0 +1,40 @@
+"""Elastic (fault-tolerant, resizable) training for horovod_tpu.
+
+TPU-native re-design of the reference elastic stack
+(/root/reference/horovod/runner/elastic/{driver,discovery,registration,
+worker}.py and horovod/common/elastic.py):
+
+* the **launcher side** keeps the reference architecture — a driver with a
+  1 Hz host-discovery thread, stable rank assignments, a worker-state
+  registry with host blacklisting, and a KV rendezvous the workers re-query
+  on reset — because that host-plane design is framework-agnostic and
+  sound;
+* the **worker side** is JAX-native: a reset tears down and re-creates the
+  JAX distributed runtime and world mesh (the analogue of the reference's
+  ``hvd.shutdown(); hvd.init()`` gloo re-rendezvous,
+  torch/elastic.py:46-49 + gloo/gloo_context.cc:157-170), and state
+  commit/restore moves jax pytrees between device and host memory.
+
+User API (mirrors ``hvd.elastic``)::
+
+    import horovod_tpu as hvd
+
+    state = hvd.elastic.JaxState(params=params, opt_state=opt_state, epoch=0)
+
+    @hvd.elastic.run
+    def train(state):
+        for state.epoch in range(state.epoch, epochs):
+            ...
+            state.commit()
+
+    train(state)
+"""
+
+from .state import State, ObjectState, JaxState  # noqa: F401
+from .run import run, run_fn  # noqa: F401
+from .discovery import (  # noqa: F401
+    HostDiscovery, HostDiscoveryScript, FixedHosts, HostManager,
+    DiscoveredHosts,
+)
+from .registration import WorkerStateRegistry, READY, SUCCESS, FAILURE  # noqa: F401
+from .driver import ElasticDriver  # noqa: F401

@@ -1,0 +1,315 @@
+"""Launcher-side elastic driver.
+
+Reference: /root/reference/horovod/runner/elastic/driver.py — ElasticDriver
+owns a 1 Hz discovery thread, computes stable host/rank assignments on
+membership change, re-publishes them to the rendezvous, notifies the
+coordinator (rank-0) worker so it can interrupt training, and (re)spawns
+worker processes on newly assigned slots. The data-plane consequence on
+TPU: every reset the workers rebuild the JAX distributed runtime and the
+device mesh; the driver only manages host membership.
+"""
+
+import logging
+import os
+import queue
+import threading
+import time
+from collections import defaultdict
+from typing import Callable, Dict, List, Optional, Tuple
+
+from ..runner.hosts import HostInfo, SlotInfo, get_host_assignments
+from .discovery import DiscoveredHosts, HostManager
+from .registration import WorkerStateRegistry
+from .worker import WorkerNotificationClient
+
+DISCOVER_HOSTS_FREQUENCY_SECS = 1.0
+DEFAULT_ELASTIC_TIMEOUT_SECS = 600
+
+log = logging.getLogger("horovod_tpu.elastic")
+
+#: Placeholder returned for (host, slot) pairs with no current assignment.
+INVALID_SLOT_INFO = SlotInfo(hostname="", rank=-1, local_rank=-1,
+                             cross_rank=-1, size=-1, local_size=-1,
+                             cross_size=-1)
+
+
+class Timeout:
+    """Deadline helper (reference runner/common/util/timeout.py)."""
+
+    def __init__(self, seconds: float, message: str):
+        self._deadline = time.monotonic() + seconds
+        self._message = message
+
+    def remaining(self) -> float:
+        return max(0.0, self._deadline - time.monotonic())
+
+    def check(self, activity: str) -> None:
+        if time.monotonic() > self._deadline:
+            raise TimeoutError(self._message.format(activity=activity))
+
+
+class Results:
+    def __init__(self, error_message: Optional[str],
+                 worker_results: Dict[str, Tuple[int, float]]):
+        self.error_message = error_message
+        self.worker_results = worker_results
+
+
+class ResultsRecorder:
+    """Collects (exit_code, timestamp) per worker of the final generation
+    (reference driver.py:44-66)."""
+
+    def __init__(self):
+        self._error_message: Optional[str] = None
+        self._worker_results: Dict[str, Tuple[int, float]] = {}
+        self._threads: "queue.Queue" = queue.Queue()
+
+    def expect(self, worker_thread: threading.Thread) -> None:
+        self._threads.put(worker_thread)
+
+    def set_error_message(self, msg: Optional[str]) -> None:
+        self._error_message = msg
+
+    def add_result(self, key: str, value: Tuple[int, float]) -> None:
+        self._worker_results.setdefault(key, value)
+
+    def get_results(self) -> Results:
+        while not self._threads.empty():
+            self._threads.get().join()
+        return Results(self._error_message, self._worker_results)
+
+
+class ElasticDriver:
+    """Drives elastic membership for one job.
+
+    ``create_worker_fn(slot_info, events) -> (exit_code, timestamp)`` is
+    supplied by the launcher (it execs the user command over ssh/local) or
+    by tests (a stub). ``events`` are [shutdown_event, host_event]: the
+    worker runner should terminate its process when either fires.
+    """
+
+    def __init__(self, rendezvous, discovery, min_np: int,
+                 max_np: Optional[int] = None,
+                 timeout: Optional[float] = None,
+                 reset_limit: Optional[int] = None):
+        self._rendezvous = rendezvous
+        self._host_manager = HostManager(discovery)
+        self._min_np = min_np
+        self._max_np = max_np
+        self._timeout = timeout or float(
+            os.getenv("HVD_TPU_ELASTIC_TIMEOUT",
+                      os.getenv("HOROVOD_ELASTIC_TIMEOUT",
+                                DEFAULT_ELASTIC_TIMEOUT_SECS)))
+
+        self._host_assignments: Dict[str, List[SlotInfo]] = {}
+        self._rank_assignments: Dict[int, SlotInfo] = {}
+        self._world_size = 0
+
+        self._wait_hosts_cond = threading.Condition()
+        self._create_worker_fn: Optional[Callable] = None
+        self._assignments_callback: Optional[Callable] = None
+        self._worker_clients: Dict[Tuple[str, int],
+                                   WorkerNotificationClient] = {}
+
+        self._worker_registry = WorkerStateRegistry(
+            self, self._host_manager, reset_limit=reset_limit)
+        self._results = ResultsRecorder()
+        self._shutdown = threading.Event()
+
+        self._discovery_thread = threading.Thread(
+            target=self._discover_hosts, name="hvd-elastic-discovery",
+            daemon=True)
+        self._discovery_thread.start()
+
+    def set_assignments_callback(self, fn: Callable) -> None:
+        """``fn(assignment_list)`` runs after each re-assignment has been
+        published to the rendezvous — the launcher uses it to publish the
+        new generation's JAX coordinator address."""
+        self._assignments_callback = fn
+
+    # -- lifecycle ----------------------------------------------------------
+    def start(self, np: int, create_worker_fn: Callable) -> None:
+        self._create_worker_fn = create_worker_fn
+        self._activate_workers(np)
+
+    def resume(self) -> None:
+        self._activate_workers(self._min_np)
+
+    def stop(self, error_message: Optional[str] = None) -> None:
+        self._results.set_error_message(error_message)
+        self._shutdown.set()
+        with self._wait_hosts_cond:
+            self._wait_hosts_cond.notify_all()
+        if self._rendezvous is not None:
+            self._rendezvous.stop()
+        self._discovery_thread.join(timeout=10)
+
+    def finished(self) -> bool:
+        return self._shutdown.is_set()
+
+    def get_results(self) -> Results:
+        return self._results.get_results()
+
+    # -- worker notification channel -----------------------------------------
+    def register_worker_server(self, host: str, slot: int, addresses,
+                               secret_key: bytes) -> None:
+        self._worker_clients[(host, slot)] = WorkerNotificationClient(
+            addresses, secret_key)
+
+    def get_worker_client(self, slot_info: SlotInfo
+                          ) -> Optional[WorkerNotificationClient]:
+        return self._worker_clients.get(
+            (slot_info.hostname, slot_info.local_rank))
+
+    def record_ready(self, host: str, slot: int) -> None:
+        self._worker_registry.record_ready(host, slot)
+
+    # -- assignment queries --------------------------------------------------
+    def world_size(self) -> int:
+        return self._world_size
+
+    def local_size(self, host: str) -> int:
+        return len(self._host_assignments.get(host, []))
+
+    def get_slot_info(self, host: str, slot: int) -> SlotInfo:
+        if not self.has_rank_assignment(host, slot):
+            return INVALID_SLOT_INFO
+        return self._host_assignments[host][slot]
+
+    def get_coordinator_info(self) -> Optional[SlotInfo]:
+        return self._rank_assignments.get(0)
+
+    def has_rank_assignment(self, host: str, slot: int) -> bool:
+        if self._host_manager.is_blacklisted(host):
+            return False
+        return host in self._host_assignments \
+            and len(self._host_assignments[host]) > slot
+
+    @property
+    def host_assignments(self) -> Dict[str, List[SlotInfo]]:
+        return self._host_assignments
+
+    # -- internals ----------------------------------------------------------
+    def wait_for_available_slots(self, min_np: int,
+                                 min_hosts: int = 1) -> DiscoveredHosts:
+        tmout = Timeout(
+            self._timeout,
+            "Timed out waiting for {activity}. Ensure that at least "
+            f"{min_np} slots are discoverable.")
+        with self._wait_hosts_cond:
+            while True:
+                current = self._host_manager.current_hosts
+                if current.count_available_slots() >= min_np \
+                        and len(current.available_hosts) >= min_hosts:
+                    return current
+                if self._shutdown.is_set():
+                    raise RuntimeError(
+                        "elastic job has been shut down while waiting for "
+                        "available slots")
+                self._wait_hosts_cond.wait(min(tmout.remaining(), 1.0))
+                tmout.check("minimum number of slots to become available")
+
+    def _activate_workers(self, min_np: int) -> None:
+        current = self.wait_for_available_slots(min_np)
+        pending = self._update_host_assignments(current)
+        self._worker_registry.reset(self.world_size())
+        for slot_info in pending:
+            self._start_worker_process(slot_info)
+
+    def _discover_hosts(self) -> None:
+        first = True
+        while not self._shutdown.is_set():
+            with self._wait_hosts_cond:
+                try:
+                    if self._host_manager.update_available_hosts():
+                        self._notify_workers_host_changes(
+                            self._host_manager.current_hosts)
+                        self._wait_hosts_cond.notify_all()
+                except RuntimeError:
+                    if first:
+                        # Fail fast on a broken discovery script.
+                        self._shutdown.set()
+                        self._wait_hosts_cond.notify_all()
+                        raise
+                    log.warning("elastic: discovery failed; retrying",
+                                exc_info=True)
+            first = False
+            self._shutdown.wait(DISCOVER_HOSTS_FREQUENCY_SECS)
+
+    def _notify_workers_host_changes(self, current: DiscoveredHosts) -> None:
+        next_assignments = {}
+        if current.count_available_slots() >= self._min_np:
+            next_assignments, _ = self._compute_assignments(current)
+        if next_assignments == self.host_assignments:
+            return  # membership changed but ranks would not
+        coord = self.get_coordinator_info()
+        if not coord:
+            return
+        client = self.get_worker_client(coord)
+        if not client:
+            return
+        try:
+            client.notify_hosts_updated(time.time())
+        except Exception:
+            log.debug("elastic: failed to notify coordinator of host "
+                      "changes", exc_info=True)
+
+    def _compute_assignments(self, current: DiscoveredHosts):
+        host_list = [HostInfo(h, current.get_slots(h))
+                     for h in current.host_assignment_order]
+        assignment_list, _size = get_host_assignments(
+            host_list, self._min_np, self._max_np)
+        by_host = defaultdict(list)
+        for s in assignment_list:
+            by_host[s.hostname].append(s)
+        return dict(by_host), assignment_list
+
+    def _update_host_assignments(self, current: DiscoveredHosts
+                                 ) -> List[SlotInfo]:
+        active = {(host, s.local_rank)
+                  for host, slots in self._host_assignments.items()
+                  for s in slots}
+        by_host, assignment_list = self._compute_assignments(current)
+        if self._host_assignments:
+            if not (self._host_assignments.keys() & by_host.keys()):
+                raise RuntimeError(
+                    "no hosts from the previous generation remain; there is "
+                    "no surviving rank to broadcast state from")
+        self._host_assignments = by_host
+        self._world_size = len(assignment_list)
+        self._rendezvous.init(assignment_list)
+        if self._assignments_callback is not None:
+            self._assignments_callback(assignment_list)
+        self._rank_assignments = {s.rank: s for s in assignment_list}
+        return [s for host, slots in by_host.items() for s in slots
+                if (host, s.local_rank) not in active]
+
+    def _start_worker_process(self, slot_info: SlotInfo) -> None:
+        create_worker_fn = self._create_worker_fn
+        shutdown_event = self._shutdown
+        host_event = self._host_manager.get_host_event(slot_info.hostname)
+
+        def run_worker():
+            res = create_worker_fn(slot_info, [shutdown_event, host_event])
+            exit_code, timestamp = res
+            self._handle_worker_exit(slot_info, exit_code, timestamp)
+
+        thread = threading.Thread(target=run_worker, daemon=True,
+                                  name=f"hvd-elastic-worker-{slot_info.rank}")
+        thread.start()
+        self._results.expect(thread)
+
+    def _handle_worker_exit(self, slot_info: SlotInfo, exit_code: int,
+                            timestamp: float) -> None:
+        if not self.has_rank_assignment(slot_info.hostname,
+                                        slot_info.local_rank):
+            return  # blacklisted or stale generation
+        if exit_code == 0:
+            rid = self._worker_registry.record_success(
+                slot_info.hostname, slot_info.local_rank)
+        else:
+            rid = self._worker_registry.record_failure(
+                slot_info.hostname, slot_info.local_rank)
+        if self.finished() and self._worker_registry.last_rendezvous() == rid:
+            name = f"{slot_info.hostname}[{slot_info.local_rank}]"
+            self._results.add_result(name, (exit_code, timestamp))

@@ -1,0 +1,144 @@
+"""Elastic launch entry point for ``horovodrun-tpu``.
+
+Reference: /root/reference/horovod/runner/gloo_run.py launch_gloo_elastic
+(:276-324) — start a rendezvous with live elastic handlers, build the
+driver, and hand it a ``create_worker_fn`` that execs the user command on
+the assigned host and kills the process tree when the driver's shutdown
+event or the host's change event fires.
+"""
+
+import os
+import random
+import socket
+import threading
+import time
+from typing import List, Optional
+
+from ..runner import config_parser
+from ..runner.exec_run import is_local_host, slot_env, _remote_command
+from ..runner.hosts import SlotInfo
+from ..runner.launch import free_port
+from ..runner.rendezvous import RendezvousServer
+from ..runner.safe_exec import safe_exec
+from .discovery import FixedHosts, HostDiscoveryScript
+from .driver import ElasticDriver
+from .rendezvous import attach_elastic_handlers
+
+
+def _make_create_worker_fn(command, rendezvous, rendezvous_addr: str,
+                           rendezvous_port: int, base_env: dict,
+                           output_dir: Optional[str] = None):
+    """Build the driver's create_worker_fn (reference gloo_run.py:
+    _exec_command_fn + get_run_command)."""
+
+    def create_worker(slot_info: SlotInfo, events: List[threading.Event]):
+        # The driver publishes the generation's coordinator address to the
+        # rendezvous before spawning, so reading it here is race-free.
+        coord = rendezvous.get("coordinator", "addr")
+        coordinator_addr = coord.decode() if coord else ""
+        env = slot_env(slot_info, coordinator_addr,
+                       rendezvous_addr=rendezvous_addr,
+                       rendezvous_port=rendezvous_port,
+                       elastic=True, base_env=base_env)
+        if is_local_host(slot_info.hostname):
+            cmd = list(command)
+        else:
+            cmd = _remote_command(command, env, slot_info.hostname,
+                                  ("PATH", "PYTHONPATH", "JAX_PLATFORMS",
+                                   "XLA_FLAGS"))
+        stop = threading.Event()
+
+        def watch_events():
+            while not stop.is_set():
+                if any(e.is_set() for e in events):
+                    stop.set()
+                    return
+                time.sleep(0.1)
+
+        watcher = threading.Thread(target=watch_events, daemon=True)
+        watcher.start()
+        out_file = None
+        try:
+            if output_dir:
+                os.makedirs(output_dir, exist_ok=True)
+                out_file = open(
+                    os.path.join(output_dir,
+                                 f"{slot_info.hostname}.{slot_info.local_rank}"
+                                 f".log"), "w", buffering=1)
+            code = safe_exec(
+                cmd, env=env,
+                stdout_prefix=f"[{slot_info.rank}]<stdout> ",
+                stop_event=stop, stdout_file=out_file)
+        finally:
+            stop.set()
+            if out_file:
+                out_file.close()
+        return code, time.time()
+
+    return create_worker
+
+
+def launch_elastic(args) -> int:
+    """Run an elastic job from parsed ``horovodrun-tpu`` args
+    (reference launch.py:574 _run_elastic)."""
+    if args.host_discovery_script:
+        discovery = HostDiscoveryScript(args.host_discovery_script,
+                                        default_slots=args.slots or 1)
+    elif args.hosts:
+        from ..runner.hosts import parse_hosts
+        discovery = FixedHosts({h.hostname: h.slots
+                                for h in parse_hosts(args.hosts)})
+    else:
+        raise ValueError(
+            "elastic mode requires --host-discovery-script (or --hosts for "
+            "a fixed set)")
+
+    min_np = args.min_np or args.np or 1
+    max_np = args.max_np
+
+    rendezvous = RendezvousServer(verbose=args.verbose)
+    rendezvous.start()
+
+    driver = ElasticDriver(
+        rendezvous, discovery, min_np=min_np, max_np=max_np,
+        timeout=args.elastic_timeout, reset_limit=args.reset_limit)
+    attach_elastic_handlers(rendezvous, driver)
+
+    def publish_coordinator(assignment_list):
+        # New generation -> new JAX coordinator on the new rank-0 host.
+        head = assignment_list[0]
+        host = "127.0.0.1" if is_local_host(head.hostname) \
+            else head.hostname
+        port = random.randint(29500, 59999) if not is_local_host(
+            head.hostname) else free_port()
+        rendezvous.put("coordinator", "addr", f"{host}:{port}".encode())
+
+    driver.set_assignments_callback(publish_coordinator)
+
+    base_env = config_parser.set_env_from_args(dict(os.environ), args)
+    rdv_host = socket.gethostname()
+    try:
+        socket.gethostbyname(rdv_host)
+    except OSError:
+        rdv_host = "127.0.0.1"
+
+    create_worker_fn = _make_create_worker_fn(
+        args.command, rendezvous, rdv_host, rendezvous.port, base_env,
+        output_dir=args.output_filename)
+
+    driver.start(min_np, create_worker_fn)
+    results = driver.get_results()
+    driver.stop()
+
+    if results.error_message:
+        import sys
+        sys.stderr.write(results.error_message + "\n")
+        return 1
+    for name, (code, _ts) in results.worker_results.items():
+        if code != 0:
+            import sys
+            sys.stderr.write(
+                f"horovodrun-tpu: elastic worker {name} exited with "
+                f"code {code}\n")
+            return code
+    return 0

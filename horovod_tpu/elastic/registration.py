@@ -1,0 +1,151 @@
+"""Worker state registry: barriers worker generations through resets.
+
+Reference: /root/reference/horovod/runner/elastic/registration.py.
+Every worker generation records READY (re-rendezvoused) / SUCCESS /
+FAILURE; a threading.Barrier sized to the world fires the transition
+action once all are in: stop on any SUCCESS or total failure, otherwise
+blacklist failing hosts and resume with a fresh rendezvous. A worker that
+recorded READY but later fails resets the barrier so it is not counted
+twice.
+"""
+
+import logging
+import threading
+from typing import Optional, Set, Tuple
+
+READY = "READY"
+SUCCESS = "SUCCESS"
+FAILURE = "FAILURE"
+
+RESET_LIMIT_EXCEEDED_MESSAGE = (
+    "Exceeded the permitted number of elastic resets ({}); terminating the "
+    "job. A reset limit typically guards against thrashing clusters; raise "
+    "--reset-limit if frequent membership changes are expected.")
+
+log = logging.getLogger("horovod_tpu.elastic")
+
+
+class WorkerStateRegistry:
+    def __init__(self, driver, host_manager, reset_limit: Optional[int] = None):
+        self._driver = driver
+        self._host_manager = host_manager
+        self._reset_limit = reset_limit
+        self._reset_count = 0
+        self._lock = threading.Lock()
+        self._states: dict = {}
+        self._by_state: dict = {READY: set(), SUCCESS: set(), FAILURE: set()}
+        self._barrier: Optional[threading.Barrier] = None
+        self._rendezvous_id = 0
+        self._size = 0
+
+    # -- introspection ------------------------------------------------------
+    def get(self, state: str) -> Set[Tuple[str, int]]:
+        return self._by_state.setdefault(state, set())
+
+    def count(self, state: str) -> int:
+        return len(self.get(state))
+
+    def recorded_slots(self):
+        return self._states.keys()
+
+    def size(self) -> int:
+        return self._size
+
+    def last_rendezvous(self) -> int:
+        return self._rendezvous_id
+
+    # -- lifecycle ----------------------------------------------------------
+    def reset(self, size: int) -> None:
+        with self._lock:
+            self._states.clear()
+            for s in self._by_state.values():
+                s.clear()
+            self._barrier = threading.Barrier(parties=size,
+                                              action=self._on_all_recorded)
+            self._rendezvous_id += 1
+            self._size = size
+
+    def record_ready(self, host: str, slot: int) -> int:
+        return self._record(host, slot, READY)
+
+    def record_success(self, host: str, slot: int) -> int:
+        return self._record(host, slot, SUCCESS)
+
+    def record_failure(self, host: str, slot: int) -> int:
+        return self._record(host, slot, FAILURE)
+
+    def _record(self, host: str, slot: int, state: str) -> int:
+        if self._driver.finished():
+            return self._rendezvous_id
+        if self._host_manager.is_blacklisted(host):
+            return self._rendezvous_id
+
+        key = (host, slot)
+        with self._lock:
+            prior = self._states.get(key)
+            if prior is not None:
+                if state == FAILURE and prior != FAILURE:
+                    # The READY thread for this worker is already parked at
+                    # the barrier; reset it so the worker is counted once.
+                    log.info("elastic: %s[%s] %s -> FAILURE, resetting "
+                             "barrier", host, slot, prior)
+                    self._barrier.reset()
+                else:
+                    # Duplicate record (e.g. a retried rendezvous GET):
+                    # do NOT wait at the barrier again or the party count
+                    # would be inflated and the generation would hang.
+                    log.debug("elastic: ignoring duplicate state %s for "
+                              "%s[%s] (have %s)", state, host, slot, prior)
+                    return self._rendezvous_id
+            self._states[key] = state
+            self.get(state).add(key)
+            rid = self._rendezvous_id
+
+        return self._wait(key, state, rid)
+
+    def _wait(self, key, state, rendezvous_id: int) -> int:
+        while True:
+            try:
+                self._barrier.wait()
+                return rendezvous_id
+            except threading.BrokenBarrierError:
+                if self._barrier.broken:
+                    raise
+                with self._lock:
+                    rendezvous_id = self._rendezvous_id
+                    saved = self._states.get(key, state)
+                    if saved != state:
+                        raise RuntimeError(
+                            f"elastic worker state {state} overridden by "
+                            f"{saved}") from None
+
+    # -- barrier action (runs on the last arriving thread) -------------------
+    def _on_all_recorded(self):
+        if self.count(SUCCESS) > 0:
+            log.info("elastic: %d worker(s) succeeded; stopping job",
+                     self.count(SUCCESS))
+            self._driver.stop()
+            return
+        if self.count(FAILURE) == self._size:
+            log.error("elastic: all %d workers failed; stopping job",
+                      self._size)
+            self._driver.stop()
+            return
+        for host, _slot in self.get(FAILURE):
+            self._host_manager.blacklist(host)
+        if all(self._host_manager.is_blacklisted(h)
+               for h, _ in self.recorded_slots()):
+            log.error("elastic: every active host is blacklisted; stopping")
+            self._driver.stop()
+            return
+        if self._reset_limit is not None \
+                and self._reset_count >= self._reset_limit:
+            self._driver.stop(error_message=RESET_LIMIT_EXCEEDED_MESSAGE
+                              .format(self._reset_limit))
+            return
+        try:
+            self._reset_count += 1
+            self._driver.resume()
+        except Exception:
+            log.exception("elastic: failed to resume with new hosts")
+            self._driver.stop()

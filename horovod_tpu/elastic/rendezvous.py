@@ -1,0 +1,40 @@
+"""Elastic rendezvous: live slot lookups backed by the driver.
+
+Reference: /root/reference/horovod/runner/elastic/rendezvous.py — in an
+elastic job the ``rank_and_size`` scope must not be a static table: each
+GET both *registers the worker as ready* for the current generation and
+returns its freshly computed assignment; PUTs to ``worker_addresses``
+register the worker's notification service with the driver.
+"""
+
+import pickle
+
+from ..runner.rendezvous import RendezvousServer
+from .worker import PUT_WORKER_ADDRESSES
+
+GET_RANK_AND_SIZE = "rank_and_size"
+
+
+def _slot_payload(s) -> bytes:
+    return (f"{s.rank},{s.size},{s.local_rank},{s.local_size},"
+            f"{s.cross_rank},{s.cross_size}").encode()
+
+
+def attach_elastic_handlers(rendezvous: RendezvousServer, driver) -> None:
+    """Wire an ElasticDriver into a running RendezvousServer."""
+
+    def get_rank_and_size(key: str):
+        host, _, local_rank = key.rpartition(":")
+        slot = int(local_rank)
+        driver.record_ready(host, slot)
+        info = driver.get_slot_info(host, slot)
+        return _slot_payload(info)
+
+    def put_worker_addresses(key: str, value: bytes):
+        host, _, local_rank = key.rpartition(":")
+        addresses, secret_key = pickle.loads(value)
+        driver.register_worker_server(host, int(local_rank), addresses,
+                                      secret_key)
+
+    rendezvous.add_handler(GET_RANK_AND_SIZE, get_rank_and_size)
+    rendezvous.add_put_handler(PUT_WORKER_ADDRESSES, put_worker_addresses)

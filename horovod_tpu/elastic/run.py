@@ -1,0 +1,184 @@
+"""Worker-side elastic retry loop and world reset.
+
+Reference: /root/reference/horovod/common/elastic.py run_fn:147-168 (the
+sync -> train -> catch -> restore/reset loop) and torch/elastic.py:46-49
+(reset = ``hvd.shutdown(); hvd.init()``).
+
+**TPU-native reset design.** The reference can re-rendezvous Gloo/NCCL
+inside a living process. XLA cannot: ``jax.distributed.initialize`` must
+run before the first backend use, so a worker that survives a membership
+change cannot rebuild the distributed runtime in-process — and on real
+hardware a changed TPU slice topology forces a runtime restart anyway.
+The reset therefore:
+
+1. re-queries the launcher's rendezvous for this worker's new rank/size
+   (one *blocking* GET of ``rank_and_size/hostname:local_rank`` — the
+   driver holds the request until the new generation has fully formed,
+   reference gloo/gloo_context.cc:157-170 + elastic/rendezvous.py:29-60)
+   and the new generation's coordinator address (scope ``coordinator``);
+2. persists the state's committed snapshot (already host-side numpy after
+   ``save()``) to a local file;
+3. **re-execs the worker process** with the refreshed env. The restarted
+   script reaches ``@hvd.elastic.run`` again, which reloads the snapshot
+   before ``state.sync()``; newly added workers skip the reload and
+   receive state through the rank-0 broadcast in ``sync()``.
+
+Anything not stored in the State object does not survive a reset — the
+same contract as any checkpoint/restore system, and in practice the same
+contract as the reference (only State is restored there too).
+
+Outside an elastic launch (no ``HVD_TPU_ELASTIC``), reset falls back to
+in-process ``shutdown(); init()``, which is valid whenever the JAX
+distributed runtime is not (re)needed — e.g. unit tests and single-process
+development loops.
+"""
+
+import functools
+import logging
+import os
+import pickle
+import sys
+import tempfile
+
+from ..exceptions import HorovodInternalError, HostsUpdatedInterrupt
+from .worker import notification_manager
+
+log = logging.getLogger("horovod_tpu.elastic")
+
+RANK_ENV = ("HVD_TPU_RANK", "HVD_TPU_SIZE", "HVD_TPU_LOCAL_RANK",
+            "HVD_TPU_LOCAL_SIZE", "HVD_TPU_CROSS_RANK", "HVD_TPU_CROSS_SIZE")
+RESTART_STATE_ENV = "HVD_TPU_RESTART_STATE_FILE"
+
+
+def _rendezvous_client(timeout: float = 24 * 3600.0):
+    from ..runner.rendezvous import KVStoreClient
+    addr = os.environ.get("HVD_TPU_RENDEZVOUS_ADDR")
+    if not addr:
+        return None
+    port = int(os.environ.get("HVD_TPU_RENDEZVOUS_PORT", 0))
+    # The rank_and_size GET blocks server-side until the next generation
+    # forms, so the client timeout must cover the elastic timeout.
+    return KVStoreClient(addr, port, timeout=timeout)
+
+
+def requery_assignment() -> bool:
+    """Refresh this worker's rank env vars from the rendezvous.
+
+    Returns False when this worker has no slot in the new generation (its
+    host was removed) — the caller should exit cleanly. No-op (True) in
+    non-elastic runs.
+    """
+    client = _rendezvous_client()
+    if client is None:
+        return True
+    hostname = os.environ.get("HVD_TPU_HOSTNAME", "")
+    local_rank = os.environ.get("HVD_TPU_LOCAL_RANK", "0")
+    blob = client.get("rank_and_size", f"{hostname}:{local_rank}")
+    if blob is None:
+        raise HorovodInternalError(
+            "rendezvous did not return a rank assignment")
+    fields = [int(x) for x in blob.decode().split(",")]
+    if fields[0] < 0:
+        return False
+    for env_name, value in zip(RANK_ENV, fields):
+        os.environ[env_name] = str(value)
+    coord = client.get("coordinator", "addr")
+    if coord:
+        os.environ["HVD_TPU_COORDINATOR_ADDR"] = coord.decode()
+    return True
+
+
+def _persist_state(state) -> None:
+    """Write the committed snapshot next to the env for the exec'd self."""
+    saved = getattr(state, "_saved_state", None)
+    if saved is None:
+        return
+    fd, path = tempfile.mkstemp(prefix="hvd_tpu_elastic_state_",
+                                suffix=".pkl")
+    with os.fdopen(fd, "wb") as f:
+        pickle.dump(saved, f)
+    os.environ[RESTART_STATE_ENV] = path
+
+
+def maybe_load_persisted_state(state) -> bool:
+    """Reload a pre-exec snapshot into ``state`` (restarted workers only)."""
+    path = os.environ.pop(RESTART_STATE_ENV, None)
+    if not path or not os.path.exists(path):
+        return False
+    try:
+        with open(path, "rb") as f:
+            saved = pickle.load(f)
+        if hasattr(state, "_saved_state"):
+            state._saved_state = saved
+            state.restore()
+            return True
+        return False
+    finally:
+        try:
+            os.unlink(path)
+        except OSError:
+            pass
+
+
+def reset(state=None) -> None:
+    """Tear down the world and come back up on the new membership."""
+    from .. import basics
+    basics.shutdown()
+    if not requery_assignment():
+        log.info("elastic: this worker has no assignment in the new "
+                 "generation; exiting cleanly")
+        sys.exit(0)
+    if os.environ.get("HVD_TPU_ELASTIC") == "1":
+        # XLA backends cannot re-rendezvous in-process: restart the worker
+        # image with the refreshed env (see module docstring).
+        if state is not None:
+            _persist_state(state)
+        notification_manager.shutdown()
+        log.info("elastic: re-exec'ing worker for new generation "
+                 "(rank=%s size=%s)", os.environ.get("HVD_TPU_RANK"),
+                 os.environ.get("HVD_TPU_SIZE"))
+        sys.stdout.flush()
+        sys.stderr.flush()
+        os.execve(sys.executable, [sys.executable] + sys.argv,
+                  dict(os.environ))
+    else:
+        basics.init()
+
+
+def run_fn(func, reset_fn):
+    """Wrap ``func(state, ...)`` in the elastic retry loop
+    (reference common/elastic.py:147-168). ``reset_fn(state)`` re-forms
+    the world between attempts."""
+
+    @functools.wraps(func)
+    def wrapper(state, *args, **kwargs):
+        notification_manager.init()
+        notification_manager.register_listener(state)
+        maybe_load_persisted_state(state)
+        try:
+            while True:
+                state.sync()
+                try:
+                    return func(state, *args, **kwargs)
+                except HorovodInternalError:
+                    log.warning("elastic: caught HorovodInternalError; "
+                                "restoring committed state", exc_info=True)
+                    state.restore()
+                except HostsUpdatedInterrupt:
+                    log.info("elastic: hosts updated; re-initializing")
+                reset_fn(state)
+                state.on_reset()
+        finally:
+            notification_manager.remove_listener(state)
+
+    return wrapper
+
+
+def run(func):
+    """Decorator for elastic training functions::
+
+        @hvd.elastic.run
+        def train(state, ...):
+            ...
+    """
+    return run_fn(func, reset)

@@ -1,0 +1,171 @@
+"""Worker-side elastic state: commit / restore / sync.
+
+Reference: /root/reference/horovod/common/elastic.py (State:27-108,
+ObjectState:111-144) and horovod/torch/elastic.py (TorchState with
+state_dict save/restore). The TPU-native variant adds :class:`JaxState`,
+which snapshots jax pytrees to host memory (``jax.device_get``) on
+``save()`` and re-stages them (``jax.device_put``) on ``restore()`` —
+the moral equivalent of the reference's GPU->host checkpoint copies.
+"""
+
+import queue
+from typing import Any, Callable, Dict, List, Optional
+
+from ..exceptions import HostsUpdatedInterrupt
+
+
+def _default_bcast_object(obj, root_rank=0, name=None):
+    from ..functions import broadcast_object
+    return broadcast_object(obj, root_rank=root_rank, name=name)
+
+
+def _default_get_rank():
+    from .. import basics
+    return basics.rank()
+
+
+class State:
+    """Tracks in-memory state that must survive worker membership changes.
+
+    ``commit()`` = ``save()`` + host-update check; a pending host update
+    raises :class:`HostsUpdatedInterrupt` *synchronously across ranks* (the
+    pending-update timestamp is broadcast from rank 0 before raising, so
+    every worker interrupts at the same batch — reference
+    common/elastic.py:73-95).
+    """
+
+    def __init__(self, bcast_object: Optional[Callable] = None,
+                 get_rank: Optional[Callable] = None):
+        self._bcast_object = bcast_object or _default_bcast_object
+        self._rank = get_rank or _default_get_rank
+        self._host_messages: "queue.Queue" = queue.Queue()
+        self._last_updated_timestamp = 0
+        self._reset_callbacks: List[Callable] = []
+
+    def register_reset_callbacks(self, callbacks: List[Callable]) -> None:
+        """Callbacks run after every reset (e.g. re-scale the LR by the new
+        world size)."""
+        self._reset_callbacks.extend(callbacks)
+
+    def on_reset(self) -> None:
+        self._host_messages = queue.Queue()
+        self.reset()
+        for cb in self._reset_callbacks:
+            cb()
+
+    def on_hosts_updated(self, timestamp: float) -> None:
+        """Called by the worker notification service thread."""
+        self._host_messages.put(timestamp)
+
+    def commit(self) -> None:
+        self.save()
+        self.check_host_updates()
+
+    def check_host_updates(self) -> None:
+        last = prev = self._last_updated_timestamp
+        while not self._host_messages.empty():
+            ts = self._host_messages.get()
+            last = max(last, ts)
+        # Sync across ranks so every worker raises on the same step.
+        prev, self._last_updated_timestamp = self._bcast_object(
+            (prev, last), name="_hvd_elastic_host_ts")
+        if self._last_updated_timestamp > prev:
+            raise HostsUpdatedInterrupt()
+
+    # -- to be provided by subclasses ---------------------------------------
+    def save(self) -> None:
+        raise NotImplementedError
+
+    def restore(self) -> None:
+        raise NotImplementedError
+
+    def sync(self) -> None:
+        raise NotImplementedError
+
+    def reset(self) -> None:
+        pass
+
+
+class ObjectState(State):
+    """State for plain Python attribute values (reference
+    common/elastic.py:111-144): each kwarg becomes an attribute; ``sync``
+    broadcasts the committed dict from rank 0."""
+
+    def __init__(self, bcast_object: Optional[Callable] = None,
+                 get_rank: Optional[Callable] = None, **kwargs):
+        self._saved_state: Dict[str, Any] = kwargs
+        super().__init__(bcast_object=bcast_object, get_rank=get_rank)
+        self._apply_saved()
+
+    def save(self) -> None:
+        self._saved_state = {k: getattr(self, k) for k in self._saved_state}
+
+    def restore(self) -> None:
+        self._apply_saved()
+
+    def sync(self) -> None:
+        if self._saved_state:
+            self._saved_state = self._bcast_object(
+                self._saved_state, name="_hvd_elastic_object_state")
+            self._apply_saved()
+
+    def _apply_saved(self) -> None:
+        for k, v in self._saved_state.items():
+            setattr(self, k, v)
+
+
+class JaxState(ObjectState):
+    """Elastic state for jax pytrees (params / optimizer state / train
+    state) plus plain scalars.
+
+    Any attribute whose value is a jax pytree containing jax Arrays is
+    snapshotted to host numpy on ``save()`` (device memory does not survive
+    a mesh re-initialization) and re-staged with ``jax.device_put`` on
+    ``restore()``/``sync()``. Scalars ride the ObjectState path.
+
+    Example::
+
+        state = JaxState(params=params, opt_state=opt_state, batch=0)
+        state.commit()           # after an optimizer step
+        ...
+        state.restore()          # rolls params/opt_state back
+    """
+
+    def __init__(self, bcast_object: Optional[Callable] = None,
+                 get_rank: Optional[Callable] = None, sharding=None, **kwargs):
+        self._sharding = sharding   # optional target sharding for restore
+        super().__init__(bcast_object=bcast_object, get_rank=get_rank,
+                         **kwargs)
+
+    def _to_host(self, value):
+        import jax
+        return jax.device_get(value)
+
+    def _to_device(self, value):
+        import jax
+        if self._sharding is not None:
+            try:
+                return jax.device_put(value, self._sharding)
+            except (TypeError, ValueError):
+                pass
+        return jax.device_put(value)
+
+    def _is_pytree_of_arrays(self, value) -> bool:
+        import jax
+        import numpy as np
+        leaves = jax.tree_util.tree_leaves(value)
+        return bool(leaves) and all(
+            isinstance(l, (jax.Array, np.ndarray)) for l in leaves)
+
+    def save(self) -> None:
+        new_state = {}
+        for k in self._saved_state:
+            v = getattr(self, k)
+            new_state[k] = self._to_host(v) if self._is_pytree_of_arrays(v) \
+                else v
+        self._saved_state = new_state
+
+    def _apply_saved(self) -> None:
+        for k, v in self._saved_state.items():
+            setattr(self, k,
+                    self._to_device(v) if self._is_pytree_of_arrays(v) else v)

@@ -1,0 +1,108 @@
+"""Worker-side notification channel for host membership changes.
+
+Reference: /root/reference/horovod/runner/elastic/worker.py — the rank-0
+worker runs a small authenticated TCP service; the driver pushes
+"hosts updated" timestamps to it; the manager fans the timestamp out to
+registered elastic State objects, which raise HostsUpdatedInterrupt at the
+next commit. The worker advertises the service's addresses + per-job
+secret to the launcher through the rendezvous KV store
+(scope ``worker_addresses``, key ``hostname:local_rank``).
+"""
+
+import os
+import pickle
+import threading
+from typing import Optional
+
+from ..runner.network import (AckResponse, BasicClient, BasicService,
+                              make_secret_key)
+
+PUT_WORKER_ADDRESSES = "worker_addresses"
+
+
+class HostsUpdatedRequest:
+    def __init__(self, timestamp: float):
+        self.timestamp = timestamp
+
+
+class WorkerNotificationService(BasicService):
+    NAME = "hvd-tpu worker notification service"
+
+    def __init__(self, key: bytes, manager: "WorkerNotificationManager"):
+        super().__init__(self.NAME, key)
+        self._manager = manager
+
+    def _handle(self, req, client_address):
+        if isinstance(req, HostsUpdatedRequest):
+            self._manager.handle_hosts_updated(req.timestamp)
+            return AckResponse()
+        return super()._handle(req, client_address)
+
+
+class WorkerNotificationClient(BasicClient):
+    def __init__(self, addresses, key: bytes, timeout: float = 10.0):
+        super().__init__(WorkerNotificationService.NAME, addresses, key,
+                         timeout=timeout)
+
+    def notify_hosts_updated(self, timestamp: float) -> None:
+        self._send(HostsUpdatedRequest(timestamp))
+
+
+class WorkerNotificationManager:
+    """Process-wide singleton on each worker (reference worker.py:37-81)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._service: Optional[WorkerNotificationService] = None
+        self._listeners = set()
+
+    def init(self, rendezvous_addr: Optional[str] = None,
+             rendezvous_port: Optional[int] = None,
+             hostname: Optional[str] = None,
+             local_rank: Optional[int] = None) -> None:
+        with self._lock:
+            if self._service:
+                return
+            rendezvous_addr = rendezvous_addr or \
+                os.environ.get("HVD_TPU_RENDEZVOUS_ADDR") or \
+                os.environ.get("HOROVOD_GLOO_RENDEZVOUS_ADDR")
+            if not rendezvous_addr:
+                return   # not an elastic launch; nothing to register with
+            rendezvous_port = rendezvous_port if rendezvous_port is not None \
+                else int(os.environ.get(
+                    "HVD_TPU_RENDEZVOUS_PORT",
+                    os.environ.get("HOROVOD_GLOO_RENDEZVOUS_PORT", 0)))
+            hostname = hostname or os.environ.get(
+                "HVD_TPU_HOSTNAME", os.environ.get("HOROVOD_HOSTNAME", ""))
+            if local_rank is None:
+                local_rank = int(os.environ.get(
+                    "HVD_TPU_LOCAL_RANK",
+                    os.environ.get("HOROVOD_LOCAL_RANK", 0)))
+
+            key = make_secret_key()
+            self._service = WorkerNotificationService(key, self)
+
+            from ..runner.rendezvous import KVStoreClient
+            client = KVStoreClient(rendezvous_addr, rendezvous_port)
+            payload = pickle.dumps((self._service.addresses(), key))
+            client.put(PUT_WORKER_ADDRESSES, f"{hostname}:{local_rank}",
+                       payload)
+
+    def register_listener(self, listener) -> None:
+        self._listeners.add(listener)
+
+    def remove_listener(self, listener) -> None:
+        self._listeners.discard(listener)
+
+    def handle_hosts_updated(self, timestamp: float) -> None:
+        for listener in list(self._listeners):
+            listener.on_hosts_updated(timestamp)
+
+    def shutdown(self) -> None:
+        with self._lock:
+            if self._service:
+                self._service.shutdown()
+                self._service = None
+
+
+notification_manager = WorkerNotificationManager()

@@ -180,7 +180,10 @@ def _run_static(args) -> int:
     failed = [(r, c) for r, c in enumerate(codes) if c != 0]
     if failed:
         sys.stderr.write(f"horovodrun-tpu: ranks failed: {failed}\n")
-        return failed[0][1] or 1
+        # Peers of the first failing rank are torn down with SIGTERM/SIGKILL
+        # (negative codes); report the genuine failure, not the artifact.
+        primary = next((c for _r, c in failed if c > 0), failed[0][1])
+        return primary if primary > 0 else 1
     return 0
 
 

@@ -80,6 +80,7 @@ class KVStoreServer:
         self._verbose = verbose
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._handlers = dict(handlers or {})
+        self._put_handlers: Dict[str, Callable] = {}
         self._thread: Optional[threading.Thread] = None
 
     # -- server lifecycle ---------------------------------------------------
@@ -116,10 +117,27 @@ class KVStoreServer:
         with self._lock:
             self._handlers[scope] = fn
 
+    def add_put_handler(self, scope: str, fn: Callable):
+        """Register ``fn(key, value)`` observing PUTs to ``scope`` — how the
+        elastic driver learns worker notification addresses (reference
+        runner/elastic/rendezvous.py:46-54 _put_worker_addresses)."""
+        with self._lock:
+            self._put_handlers[scope] = fn
+
     # -- store --------------------------------------------------------------
     def _put(self, scope, key, value):
         with self._lock:
             self._data[(scope, key)] = value
+            handler = self._put_handlers.get(scope)
+        if handler is not None:
+            try:
+                handler(key, value)
+            except Exception:
+                # The value is already stored; an observer failure (e.g.
+                # driver mid-shutdown) must not fail the worker's PUT.
+                import logging
+                logging.getLogger("horovod_tpu.runner").exception(
+                    "put handler for scope %r failed", scope)
 
     def _get(self, scope, key):
         with self._lock:

@@ -1,0 +1,377 @@
+"""Elastic subsystem tests.
+
+Modeled on the reference's process-free driver simulation
+(/root/reference/test/test_elastic_driver.py: drives ElasticDriver with
+FixedHosts and a mock create_worker_fn) plus unit tests for discovery,
+state commit/restore, the retry loop, and the notification channel.
+"""
+
+import os
+import stat
+import sys
+import tempfile
+import threading
+import time
+
+import pytest
+
+from horovod_tpu.elastic.discovery import (FixedHosts, HostDiscoveryScript,
+                                           HostManager)
+from horovod_tpu.elastic.driver import ElasticDriver
+from horovod_tpu.elastic.state import ObjectState
+from horovod_tpu.elastic.run import run_fn
+from horovod_tpu.exceptions import (HorovodInternalError,
+                                    HostsUpdatedInterrupt)
+
+
+class FakeRendezvous:
+    """Records the assignment lists the driver publishes."""
+
+    def __init__(self):
+        self.published = []
+        self.stopped = False
+
+    def init(self, assignment_list):
+        self.published.append(list(assignment_list))
+
+    def stop(self):
+        self.stopped = True
+
+
+def _wait_until(pred, timeout=10.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(0.02)
+    return False
+
+
+# ---------------------------------------------------------------------------
+# discovery
+# ---------------------------------------------------------------------------
+
+def test_host_manager_stable_order_and_blacklist():
+    disc = FixedHosts({"a": 2, "b": 2})
+    hm = HostManager(disc)
+    assert hm.update_available_hosts()
+    assert hm.current_hosts.host_assignment_order == ["a", "b"]
+
+    # New host appends; existing order is preserved (rank stability).
+    disc.set({"c": 2, "a": 2, "b": 2})
+    assert hm.update_available_hosts()
+    assert hm.current_hosts.host_assignment_order == ["a", "b", "c"]
+
+    hm.blacklist("b")
+    assert hm.is_blacklisted("b")
+    assert hm.current_hosts.host_assignment_order == ["a", "c"]
+    assert hm.current_hosts.count_available_slots() == 4
+
+    # No change -> no update
+    assert not hm.update_available_hosts()
+
+
+def test_host_discovery_script(tmp_path):
+    script = tmp_path / "discover.sh"
+    script.write_text("#!/bin/sh\necho host-1:2\necho host-2\n")
+    script.chmod(script.stat().st_mode | stat.S_IEXEC)
+    d = HostDiscoveryScript(str(script), default_slots=4)
+    assert d.find_available_hosts_and_slots() == {"host-1": 2, "host-2": 4}
+
+
+def test_host_discovery_script_failure(tmp_path):
+    script = tmp_path / "bad.sh"
+    script.write_text("#!/bin/sh\nexit 3\n")
+    script.chmod(script.stat().st_mode | stat.S_IEXEC)
+    with pytest.raises(RuntimeError, match="exit code 3"):
+        HostDiscoveryScript(str(script)).find_available_hosts_and_slots()
+
+
+# ---------------------------------------------------------------------------
+# driver simulation (no processes)
+# ---------------------------------------------------------------------------
+
+def test_driver_assigns_ranks_and_collects_results():
+    rdv = FakeRendezvous()
+    driver = ElasticDriver(rdv, FixedHosts({"h1": 2, "h2": 2}),
+                           min_np=4, timeout=10)
+    seen = {}
+
+    def create_worker(slot_info, events):
+        seen[(slot_info.hostname, slot_info.local_rank)] = slot_info
+        return 0, time.time()
+
+    driver.start(4, create_worker)
+    results = driver.get_results()
+    assert results.error_message is None
+    assert len(results.worker_results) == 4
+    assert all(code == 0 for code, _ in results.worker_results.values())
+    assert driver.world_size() == 4
+    ranks = sorted(s.rank for s in seen.values())
+    assert ranks == [0, 1, 2, 3]
+    # host-major: h1 gets ranks 0,1
+    assert seen[("h1", 0)].rank == 0 and seen[("h1", 1)].rank == 1
+    assert seen[("h1", 0)].cross_size == 2 and seen[("h1", 0)].local_size == 2
+    driver.stop()
+
+
+def test_driver_blacklists_failed_host_and_survivor_continues():
+    rdv = FakeRendezvous()
+    driver = ElasticDriver(rdv, FixedHosts({"h1": 1, "h2": 1}),
+                           min_np=1, max_np=2, timeout=10)
+
+    def create_worker(slot_info, events):
+        if slot_info.hostname == "h2":
+            return 1, time.time()       # h2 fails immediately
+        # h1 simulates: internal error -> re-rendezvous (record_ready
+        # blocks until the new generation forms) -> finish successfully.
+        driver.record_ready("h1", 0)
+        return 0, time.time()
+
+    driver.start(2, create_worker)
+    results = driver.get_results()
+    assert driver._host_manager.is_blacklisted("h2")
+    assert driver.world_size() == 1     # survivor generation
+    assert results.worker_results.get("h1[0]") == pytest.approx(
+        results.worker_results["h1[0]"])
+    code, _ = results.worker_results["h1[0]"]
+    assert code == 0
+    driver.stop()
+
+
+def test_driver_grows_when_host_added():
+    rdv = FakeRendezvous()
+    fixed = FixedHosts({"h1": 1})
+    driver = ElasticDriver(rdv, fixed, min_np=1, max_np=2, timeout=10)
+    go = threading.Event()
+
+    def create_worker(slot_info, events):
+        if slot_info.hostname == "h1" and not getattr(
+                create_worker, "h1_restarted", False):
+            create_worker.h1_restarted = True
+            go.wait(10)
+            driver.record_ready("h1", 0)   # re-rendezvous into gen 2
+            return 0, time.time()
+        return 0, time.time()
+
+    driver.start(1, create_worker)
+    assert driver.world_size() == 1
+    fixed.set({"h1": 1, "h2": 1})
+    assert _wait_until(
+        lambda: driver._host_manager.current_hosts.count_available_slots() == 2)
+    go.set()
+    results = driver.get_results()
+    assert results.error_message is None
+    assert driver.world_size() == 2
+    # rank stability: h1 (older host) keeps rank 0
+    assert driver.get_slot_info("h1", 0).rank == 0
+    assert driver.get_slot_info("h2", 0).rank == 1
+    assert {("h1", 0), ("h2", 0)} == {
+        tuple(k.split("[")[0:1]) + (int(k.split("[")[1][:-1]),)
+        for k in results.worker_results}
+    driver.stop()
+
+
+def test_driver_all_failures_stops_job():
+    rdv = FakeRendezvous()
+    driver = ElasticDriver(rdv, FixedHosts({"h1": 2}), min_np=2, timeout=10)
+
+    def create_worker(slot_info, events):
+        return 7, time.time()
+
+    driver.start(2, create_worker)
+    results = driver.get_results()
+    assert len(results.worker_results) == 2
+    assert all(code == 7 for code, _ in results.worker_results.values())
+    assert driver.finished()
+    driver.stop()
+
+
+def test_driver_reset_limit():
+    rdv = FakeRendezvous()
+    driver = ElasticDriver(rdv, FixedHosts({"h1": 1}), min_np=1,
+                           timeout=10, reset_limit=0)
+
+    def create_worker(slot_info, events):
+        driver.record_ready("h1", 0)     # triggers a reset -> exceeds limit
+        return 0, time.time()
+
+    driver.start(1, create_worker)
+    results = driver.get_results()
+    assert results.error_message is not None
+    assert "reset" in results.error_message.lower()
+    driver.stop()
+
+
+def test_driver_wait_for_slots_timeout():
+    rdv = FakeRendezvous()
+    driver = ElasticDriver(rdv, FixedHosts({}), min_np=1, timeout=0.5)
+    with pytest.raises(TimeoutError):
+        driver.wait_for_available_slots(1)
+    driver.stop()
+
+
+# ---------------------------------------------------------------------------
+# state + retry loop
+# ---------------------------------------------------------------------------
+
+def _identity_bcast(obj, root_rank=0, name=None):
+    return obj
+
+
+def test_object_state_commit_restore():
+    s = ObjectState(bcast_object=_identity_bcast, get_rank=lambda: 0,
+                    batch=0, epoch=0)
+    s.batch, s.epoch = 5, 1
+    s.commit()
+    s.batch = 99
+    s.restore()
+    assert s.batch == 5 and s.epoch == 1
+
+
+def test_object_state_host_update_raises_on_commit():
+    s = ObjectState(bcast_object=_identity_bcast, get_rank=lambda: 0, n=0)
+    s.on_hosts_updated(time.time())
+    with pytest.raises(HostsUpdatedInterrupt):
+        s.commit()
+    # after the interrupt, the timestamp is consumed
+    s.commit()
+
+
+def test_run_fn_retry_loop():
+    s = ObjectState(bcast_object=_identity_bcast, get_rank=lambda: 0, n=0)
+    resets = []
+    attempts = []
+
+    def my_reset(state):
+        resets.append(1)
+
+    def train(state):
+        attempts.append(1)
+        if len(attempts) == 1:
+            raise HorovodInternalError("boom")
+        if len(attempts) == 2:
+            raise HostsUpdatedInterrupt()
+        return "done"
+
+    wrapped = run_fn(train, my_reset)
+    assert wrapped(s) == "done"
+    assert len(attempts) == 3
+    assert len(resets) == 2
+
+
+def test_jax_state_save_restore(hvd_world):
+    import jax.numpy as jnp
+    from horovod_tpu.elastic.state import JaxState
+
+    params = {"w": jnp.ones((4, 4)), "b": jnp.zeros((4,))}
+    s = JaxState(bcast_object=_identity_bcast, get_rank=lambda: 0,
+                 params=params, step=0)
+    s.params = {"w": s.params["w"] * 3.0, "b": s.params["b"] + 1.0}
+    s.step = 10
+    s.commit()
+    s.params = {"w": s.params["w"] * 100.0, "b": s.params["b"]}
+    s.step = 11
+    s.restore()
+    assert float(s.params["w"][0, 0]) == 3.0
+    assert float(s.params["b"][0]) == 1.0
+    assert s.step == 10
+    s.sync()     # single process: broadcast is identity
+    assert float(s.params["w"][0, 0]) == 3.0
+
+
+# ---------------------------------------------------------------------------
+# worker notification channel
+# ---------------------------------------------------------------------------
+
+def test_notification_service_roundtrip():
+    from horovod_tpu.elastic.worker import (WorkerNotificationClient,
+                                            WorkerNotificationService)
+    from horovod_tpu.runner.network import make_secret_key
+
+    received = []
+
+    class Manager:
+        def handle_hosts_updated(self, ts):
+            received.append(ts)
+
+    key = make_secret_key()
+    svc = WorkerNotificationService(key, Manager())
+    try:
+        client = WorkerNotificationClient(
+            {"lo": [("127.0.0.1", svc.port)]}, key)
+        client.notify_hosts_updated(123.0)
+        assert _wait_until(lambda: received == [123.0], 5)
+    finally:
+        svc.shutdown()
+
+
+def test_notification_service_rejects_bad_key():
+    from horovod_tpu.elastic.worker import (WorkerNotificationClient,
+                                            WorkerNotificationService)
+    from horovod_tpu.runner.network import make_secret_key
+
+    received = []
+
+    class Manager:
+        def handle_hosts_updated(self, ts):
+            received.append(ts)
+
+    svc = WorkerNotificationService(make_secret_key(), Manager())
+    try:
+        bad = WorkerNotificationClient(
+            {"lo": [("127.0.0.1", svc.port)]}, make_secret_key())
+        with pytest.raises(ConnectionError):
+            bad.notify_hosts_updated(1.0)
+        assert received == []
+    finally:
+        svc.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# elastic rendezvous handlers + worker requery
+# ---------------------------------------------------------------------------
+
+def test_elastic_rendezvous_and_requery(monkeypatch):
+    from horovod_tpu.elastic.rendezvous import attach_elastic_handlers
+    from horovod_tpu.elastic.run import requery_assignment
+    from horovod_tpu.runner.hosts import SlotInfo
+    from horovod_tpu.runner.rendezvous import RendezvousServer
+
+    ready = []
+
+    class StubDriver:
+        def record_ready(self, host, slot):
+            ready.append((host, slot))
+
+        def get_slot_info(self, host, slot):
+            return SlotInfo(hostname=host, rank=3, local_rank=slot,
+                            cross_rank=1, size=8, local_size=4, cross_size=2)
+
+        def register_worker_server(self, host, slot, addresses, key):
+            pass
+
+    rdv = RendezvousServer()
+    rdv.start()
+    try:
+        attach_elastic_handlers(rdv, StubDriver())
+        rdv.put("coordinator", "addr", b"10.0.0.9:4321")
+        # requery_assignment writes these; register them with monkeypatch so
+        # they are restored after the test (hvd.init() would otherwise try to
+        # join a phantom 8-process world).
+        for var in ("HVD_TPU_RANK", "HVD_TPU_SIZE", "HVD_TPU_LOCAL_SIZE",
+                    "HVD_TPU_CROSS_RANK", "HVD_TPU_CROSS_SIZE",
+                    "HVD_TPU_COORDINATOR_ADDR"):
+            monkeypatch.setenv(var, "")  # registers teardown restore
+        monkeypatch.setenv("HVD_TPU_RENDEZVOUS_ADDR", "127.0.0.1")
+        monkeypatch.setenv("HVD_TPU_RENDEZVOUS_PORT", str(rdv.port))
+        monkeypatch.setenv("HVD_TPU_HOSTNAME", "worker-a")
+        monkeypatch.setenv("HVD_TPU_LOCAL_RANK", "1")
+        assert requery_assignment()
+        assert ready == [("worker-a", 1)]
+        assert os.environ["HVD_TPU_RANK"] == "3"
+        assert os.environ["HVD_TPU_SIZE"] == "8"
+        assert os.environ["HVD_TPU_LOCAL_RANK"] == "1"
+        assert os.environ["HVD_TPU_COORDINATOR_ADDR"] == "10.0.0.9:4321"
+    finally:
+        rdv.stop()
