@@ -1,0 +1,79 @@
+"""Lazy native build: compile csrc/*.cc into _libhvdtpu.so with the system
+C++ toolchain on first use.
+
+The reference ships its native core through setup.py CMake extensions built
+at pip-install time (/root/reference/setup.py). Here the library is small and
+dependency-free, so it is built on demand next to the sources, keyed by a
+content hash — a fresh checkout self-builds on first import, and editing a
+.cc transparently rebuilds. Set HVD_TPU_NATIVE=0 to skip native entirely
+(pure-Python fallbacks cover every component).
+"""
+
+import hashlib
+import os
+import subprocess
+import tempfile
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+CSRC = os.path.join(_HERE, "csrc")
+LIB_BASENAME = "_libhvdtpu.so"
+
+
+def _sources():
+    return sorted(
+        os.path.join(CSRC, f) for f in os.listdir(CSRC)
+        if f.endswith((".cc", ".hpp")))
+
+
+def _content_hash() -> str:
+    h = hashlib.sha256()
+    for path in _sources():
+        h.update(os.path.basename(path).encode())
+        with open(path, "rb") as f:
+            h.update(f.read())
+    return h.hexdigest()[:16]
+
+
+def lib_path() -> str:
+    return os.path.join(_HERE, LIB_BASENAME)
+
+
+def _stamp_path() -> str:
+    return lib_path() + ".stamp"
+
+
+def build(force: bool = False) -> str:
+    """Build (or reuse) the shared library; returns its path.
+
+    Raises RuntimeError when no working C++ toolchain is available — callers
+    fall back to pure Python.
+    """
+    want = _content_hash()
+    lib = lib_path()
+    stamp = _stamp_path()
+    if not force and os.path.exists(lib) and os.path.exists(stamp):
+        with open(stamp) as f:
+            if f.read().strip() == want:
+                return lib
+
+    cxx = os.environ.get("CXX", "g++")
+    srcs = [s for s in _sources() if s.endswith(".cc")]
+    # Compile into a temp file then atomically rename, so a concurrent
+    # process never dlopens a half-written .so.
+    fd, tmp = tempfile.mkstemp(suffix=".so", dir=_HERE)
+    os.close(fd)
+    cmd = [cxx, "-O2", "-std=c++17", "-fPIC", "-shared", "-pthread",
+           "-fvisibility=hidden", "-o", tmp] + srcs
+    try:
+        proc = subprocess.run(cmd, capture_output=True, text=True, timeout=300)
+    except (OSError, subprocess.TimeoutExpired) as e:
+        os.unlink(tmp)
+        raise RuntimeError(f"native build failed to run {cxx}: {e}") from e
+    if proc.returncode != 0:
+        os.unlink(tmp)
+        raise RuntimeError(
+            f"native build failed:\n{proc.stderr[-4000:]}")
+    os.replace(tmp, lib)
+    with open(stamp, "w") as f:
+        f.write(want)
+    return lib

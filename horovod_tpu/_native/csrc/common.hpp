@@ -1,0 +1,28 @@
+// Common declarations for the horovod_tpu native host runtime.
+//
+// TPU-native analogue of the reference's C++ core (/root/reference/horovod/
+// common/): on TPU the data plane is XLA-compiled collectives, so what stays
+// native is the *host* runtime around it — submission table, response cache,
+// fusion planning, stall detection, timeline writing, autotuning — the same
+// components the reference implements in horovod/common/{tensor_queue,
+// response_cache,fusion_buffer_manager,stall_inspector,timeline,
+// parameter_manager}.{h,cc}, re-designed for a single-controller-per-host
+// world and exposed through a flat C API consumed over ctypes.
+#pragma once
+
+#include <cstdint>
+#include <cstring>
+
+#if defined(_WIN32)
+#define HVD_EXPORT extern "C" __declspec(dllexport)
+#else
+#define HVD_EXPORT extern "C" __attribute__((visibility("default")))
+#endif
+
+namespace hvdtpu {
+
+// IEEE CRC-32 (matches Python zlib.crc32 so fingerprints agree between the
+// native and pure-Python wire paths).
+uint32_t crc32_ieee(const uint8_t* data, int64_t len);
+
+}  // namespace hvdtpu

@@ -1,0 +1,6 @@
+// Library identity.
+#include "common.hpp"
+
+// Bumped whenever the C API changes shape; the Python loader refuses a
+// stale cached .so whose ABI does not match (and rebuilds from source).
+HVD_EXPORT int32_t hvd_abi_version() { return 1; }

@@ -145,6 +145,8 @@ def init(process_sets: Optional[Sequence[Sequence[int]]] = None,
         w.timeline = maybe_start_timeline(w)
         from .stall import StallInspector
         w.stall_inspector = StallInspector(w)
+        from .parameter_manager import maybe_create as _maybe_autotune
+        w.parameter_manager = _maybe_autotune(w)
 
         _world = w
         atexit.register(_shutdown_quietly)

@@ -128,33 +128,78 @@ def _local_result(out):
     return out.addressable_data(0)
 
 
+def _response_cache(w):
+    if getattr(w, "_response_cache", None) is None:
+        from .response_cache import ResponseCache
+        w._response_cache = ResponseCache(w.config.get(_config.CACHE_CAPACITY))
+    return w._response_cache
+
+
 def _check_consistency(w, wm, name, shape, dtype, kind, extra=""):
     """Cross-process metadata validation (controller.cc:378-611 analogue).
 
-    Allgathers a 32-bit fingerprint of (name, shape, dtype, op) across
-    processes and raises listing mismatching processes. Only runs when
-    HVD_TPU_CHECK_CONSISTENCY is enabled and the world is multi-process.
+    Allgathers a 64-bit word — (exchange sequence number << 32) | metadata
+    fingerprint — across processes and raises listing mismatching processes.
+    Only runs when HVD_TPU_CHECK_CONSISTENCY is enabled and the world is
+    multi-process. Steady state skips the exchange via the ResponseCache: a
+    fingerprint validated once is not re-exchanged until evicted (the
+    reference's cache fast path, response_cache.h:104-160).
+
+    Divergence safety: the cache decision is per-process, so if processes
+    ever submit *different* collective sequences (the only way their
+    deterministic caches can diverge — a user error this check exists to
+    catch), one process may skip an exchange another executes. The sequence
+    number makes that mispairing a hard error on the next exchange instead of
+    silent corruption: mispaired exchanges carry different seq values. A
+    process that never exchanges again is caught by the stall inspector
+    (stall.py), the same backstop the reference relies on for lost ranks.
+    Exchanges are serialized per process (``_exchange_lock``) so concurrent
+    submitter threads produce one total order.
     """
     if wm.num_procs <= 1:
         return
     if not w.config.get(_config.CHECK_CONSISTENCY):
         return
     fp = metadata_fingerprint(name, shape, dtype, kind, extra)
-    garr = _global_from_local(wm, np.array([fp], dtype=np.uint32))
+    cache = _response_cache(w)
+    cache_key = (hash(wm.cache_key) & 0xFFFFFFFF) << 32 | fp
+    with _name_lock:
+        if not hasattr(w, "_consistency_lock"):
+            w._consistency_lock = threading.Lock()
+            w._consistency_seq = 0
+    with w._consistency_lock:
+        if cache.lookup(cache_key):
+            return
+        w._consistency_seq = (w._consistency_seq + 1) & 0x7FFFFFFF
+        # two u32 lanes (not one u64: without jax_enable_x64, uint64 arrays
+        # silently truncate to uint32)
+        garr = _global_from_local(
+            wm, np.array([w._consistency_seq, fp], dtype=np.uint32))
 
-    def build():
-        return _jax().jit(
-            lambda a: a, out_shardings=wm.replicated_sharding())
-    fn = _get_program(w, ("consistency", wm.cache_key), build)
-    fps = np.asarray(_local_result(fn(garr))).reshape(-1)
-    if len(set(int(x) for x in fps)) > 1:
-        mine = int(fps[wm.my_index])
-        bad = [i for i, x in enumerate(fps) if int(x) != mine]
-        raise TensorValidationError(
-            f"Mismatched metadata for collective {name!r} ({kind}): "
-            f"processes {bad} submitted a different shape/dtype/op than "
-            f"process {wm.my_index}. All processes must submit "
-            f"identical requests for the same tensor name.")
+        def build():
+            return _jax().jit(
+                lambda a: a, out_shardings=wm.replicated_sharding())
+        fn = _get_program(w, ("consistency", wm.cache_key), build)
+        words = np.asarray(_local_result(fn(garr))).reshape(-1, 2)
+        seqs = [int(x) for x in words[:, 0]]
+        fps = [int(x) for x in words[:, 1]]
+        if len(set(seqs)) > 1:
+            raise TensorValidationError(
+                f"Consistency-exchange sequence mismatch at collective "
+                f"{name!r} ({kind}): per-process exchange counts "
+                f"{dict(enumerate(seqs))} differ, meaning processes have "
+                f"submitted different collective sequences (or their "
+                f"response caches diverged). All processes must submit the "
+                f"same collectives in the same order.")
+        if len(set(fps)) > 1:
+            mine = fps[wm.my_index]
+            bad = [i for i, x in enumerate(fps) if x != mine]
+            raise TensorValidationError(
+                f"Mismatched metadata for collective {name!r} ({kind}): "
+                f"processes {bad} submitted a different shape/dtype/op than "
+                f"process {wm.my_index}. All processes must submit "
+                f"identical requests for the same tensor name.")
+        cache.put(cache_key)
 
 
 def _combined_scale(op: ReduceOp, nproc: int, prescale: float,

@@ -138,34 +138,39 @@ class JaxState(ObjectState):
                          **kwargs)
 
     def _to_host(self, value):
+        """Per-leaf host snapshot: array leaves become numpy, every other
+        leaf (step counters, schedules, static fields of a TrainState) passes
+        through — mixed pytrees must not silently keep live device-array
+        references, which would dangle across a mesh re-initialization."""
         import jax
-        return jax.device_get(value)
+        import numpy as np
+
+        def leaf(l):
+            if isinstance(l, jax.Array):
+                return np.asarray(jax.device_get(l))
+            return l
+        return jax.tree_util.tree_map(leaf, value)
 
     def _to_device(self, value):
         import jax
+        import numpy as np
+
+        def leaf(l):
+            if isinstance(l, (jax.Array, np.ndarray)):
+                return jax.device_put(l)
+            return l
+
         if self._sharding is not None:
             try:
                 return jax.device_put(value, self._sharding)
             except (TypeError, ValueError):
                 pass
-        return jax.device_put(value)
-
-    def _is_pytree_of_arrays(self, value) -> bool:
-        import jax
-        import numpy as np
-        leaves = jax.tree_util.tree_leaves(value)
-        return bool(leaves) and all(
-            isinstance(l, (jax.Array, np.ndarray)) for l in leaves)
+        return jax.tree_util.tree_map(leaf, value)
 
     def save(self) -> None:
-        new_state = {}
-        for k in self._saved_state:
-            v = getattr(self, k)
-            new_state[k] = self._to_host(v) if self._is_pytree_of_arrays(v) \
-                else v
-        self._saved_state = new_state
+        self._saved_state = {
+            k: self._to_host(getattr(self, k)) for k in self._saved_state}
 
     def _apply_saved(self) -> None:
         for k, v in self._saved_state.items():
-            setattr(self, k,
-                    self._to_device(v) if self._is_pytree_of_arrays(v) else v)
+            setattr(self, k, self._to_device(v))

@@ -11,9 +11,12 @@ every process builds identical buckets without negotiation (the compiled-SPMD
 replacement for the rank-0 negotiation protocol, SURVEY.md §5).
 """
 
+import ctypes
 from typing import Any, Callable, List, Optional, Sequence, Tuple
 
 import numpy as np
+
+from ._native import get as _native_get
 
 
 def plan_buckets(shapes_dtypes: Sequence[Tuple[tuple, Any]],
@@ -25,14 +28,28 @@ def plan_buckets(shapes_dtypes: Sequence[Tuple[tuple, Any]],
 
     threshold_bytes <= 0 disables fusion (one bucket per tensor), matching
     HOROVOD_FUSION_THRESHOLD=0 semantics.
+
+    Runs in the native planner when built (csrc/fusion.cc, identical
+    semantics — tests assert parity).
     """
+    sizes = [int(np.prod(shape, dtype=np.int64)) * np.dtype(dtype).itemsize
+             for shape, dtype in shapes_dtypes]
+    nat = _native_get()
+    if nat is not None and sizes:
+        n = len(sizes)
+        out = (ctypes.c_int32 * n)()
+        nb = nat.cdll.hvd_plan_buckets(
+            (ctypes.c_int64 * n)(*sizes), n, int(threshold_bytes), out)
+        buckets = [[] for _ in range(int(nb))]
+        for i in range(n):
+            buckets[out[i]].append(i)
+        return buckets
     if threshold_bytes <= 0:
-        return [[i] for i in range(len(shapes_dtypes))]
+        return [[i] for i in range(len(sizes))]
     buckets: List[List[int]] = []
     cur: List[int] = []
     cur_bytes = 0
-    for i, (shape, dtype) in enumerate(shapes_dtypes):
-        nbytes = int(np.prod(shape, dtype=np.int64)) * np.dtype(dtype).itemsize
+    for i, nbytes in enumerate(sizes):
         if cur and cur_bytes + nbytes > threshold_bytes:
             buckets.append(cur)
             cur, cur_bytes = [], 0

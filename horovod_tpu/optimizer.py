@@ -111,7 +111,10 @@ class DistributedGradientTransform:
         import jax
         from .fusion import bucketed_apply
         w = _basics.world()
-        threshold = w.config.get(_config.FUSION_THRESHOLD)
+        pm = w.parameter_manager
+        autotuning = pm is not None and pm.active
+        threshold = pm.fusion_threshold if autotuning \
+            else w.config.get(_config.FUSION_THRESHOLD)
         leaves, treedef = jax.tree_util.tree_flatten(grads)
         self._step += 1
         names = [f"{self._prefix}.grad.{self._step}.{i}"
@@ -127,7 +130,22 @@ class DistributedGradientTransform:
             return [self._compression.decompress(o, ctx)
                     for o, (_, ctx) in zip(outs, comp)]
 
+        if not autotuning:
+            reduced = bucketed_apply(leaves, threshold, fused, names)
+            return jax.tree_util.tree_unflatten(treedef, reduced)
+
+        # Autotune sampling: time the reduction (blocking — only while
+        # tuning is active; reference ParameterManager likewise scores
+        # wall time per negotiated batch, parameter_manager.cc Update).
+        import time as _time
+        nbytes = sum(
+            int(np.prod(np.shape(l), dtype=np.int64))
+            * np.dtype(getattr(l, "dtype", np.float32)).itemsize
+            for l in leaves)
+        t0 = _time.perf_counter()
         reduced = bucketed_apply(leaves, threshold, fused, names)
+        jax.block_until_ready(reduced)
+        pm.record(nbytes, _time.perf_counter() - t0)
         return jax.tree_util.tree_unflatten(treedef, reduced)
 
 

@@ -1,0 +1,193 @@
+"""Online autotuning of host-plane knobs, scored by throughput.
+
+TPU-native analogue of the reference ParameterManager
+(/root/reference/horovod/common/parameter_manager.{h,cc}: warmup/sample
+schedule scoring bytes/sec, Bayesian optimization over tunables,
+parameter_manager.h:33-105) with its optimizer
+(common/optim/{bayesian_optimization,gaussian_process}.{h,cc}). On TPU the
+background cycle time and hierarchical on/off knobs don't exist — XLA owns
+the schedule — so the tuned surface is the **fusion threshold** (gradient
+bucket size): it controls eager-plane dispatch granularity, the
+latency/overlap trade the reference tunes its threshold for.
+
+Protocol (reference parameter_manager.cc Update/Tune):
+
+* every eager reduction step reports ``record(bytes, seconds)``;
+* after ``HVD_TPU_AUTOTUNE_STEPS_PER_SAMPLE`` steps a sample completes with
+  score = bytes/sec; the first ``HVD_TPU_AUTOTUNE_WARMUP_SAMPLES`` samples
+  are discarded (compilation noise);
+* each scored sample feeds the GP/EI optimizer (native csrc/bo.cc, with a
+  deterministic golden-section-style Python fallback), which proposes the
+  next threshold;
+* after ``HVD_TPU_AUTOTUNE_BAYES_OPT_MAX_SAMPLES`` samples tuning stops on
+  the best threshold seen.
+
+Cross-process agreement (reference: rank 0 tunes and broadcasts,
+controller.cc:33-47 SynchronizeParameters): local throughput measurements
+differ across processes, and divergent thresholds would make processes build
+different bucket structures — i.e. different collective sequences. So in a
+multi-process world rank 0's proposal is broadcast at every sample boundary;
+boundaries align because every process counts the same ``record()`` calls.
+"""
+
+import ctypes
+import math
+import time
+from typing import Optional
+
+from . import config as _config
+from ._native import get as _native_get
+
+# Search space: log2(threshold bytes) in [1 MB, 256 MB].
+_LOG2_LO, _LOG2_HI = 20.0, 28.0
+
+
+class _PythonFallbackOptimizer:
+    """Deterministic 1-D maximizer used when the native GP/BO is unbuilt:
+    sweeps a coarse grid, then golden-section refines around the incumbent.
+    Same interface as the native BO (observe/suggest), same determinism
+    property (identical history -> identical suggestion)."""
+
+    _GRID = [20.0, 22.0, 24.0, 26.0, 28.0]
+
+    def __init__(self, lo: float, hi: float):
+        self._lo, self._hi = lo, hi
+        self._obs = []
+
+    def observe(self, x: float, y: float):
+        self._obs.append((x, y))
+
+    def suggest(self) -> float:
+        n = len(self._obs)
+        if n < len(self._GRID):
+            return self._GRID[n]
+        best_x, _ = max(self._obs, key=lambda o: o[1])
+        # shrinking probes alternating around the incumbent
+        k = n - len(self._GRID)
+        step = (self._hi - self._lo) / (2.0 ** (k // 2 + 2))
+        probe = best_x + (step if k % 2 == 0 else -step)
+        return min(self._hi, max(self._lo, probe))
+
+
+class _NativeOptimizer:
+    def __init__(self, nat, lo: float, hi: float, seed: int = 1234):
+        self._nat = nat
+        self._b = nat.cdll.hvd_bo_create(
+            1, (ctypes.c_double * 1)(lo), (ctypes.c_double * 1)(hi), seed)
+
+    def __del__(self):
+        if getattr(self, "_b", None):
+            try:
+                self._nat.cdll.hvd_bo_destroy(self._b)
+            except Exception:
+                pass
+
+    def observe(self, x: float, y: float):
+        self._nat.cdll.hvd_bo_observe(self._b, (ctypes.c_double * 1)(x), y)
+
+    def suggest(self) -> float:
+        out = (ctypes.c_double * 1)()
+        self._nat.cdll.hvd_bo_suggest(self._b, 512, out)
+        return float(out[0])
+
+
+class ParameterManager:
+    """Created by ``init()`` when HVD_TPU_AUTOTUNE is set; consulted by the
+    eager reduction path (optimizer.py) each step."""
+
+    def __init__(self, world):
+        cfg = world.config
+        self._world = world
+        self._warmup_left = int(cfg.get(_config.AUTOTUNE_WARMUP_SAMPLES))
+        self._steps_per_sample = max(
+            1, int(cfg.get(_config.AUTOTUNE_STEPS_PER_SAMPLE)))
+        self._max_samples = int(
+            cfg.get(_config.AUTOTUNE_BAYES_OPT_MAX_SAMPLES))
+        self._log_path = cfg.get(_config.AUTOTUNE_LOG)
+        nat = _native_get()
+        if nat is not None:
+            self._opt = _NativeOptimizer(nat, _LOG2_LO, _LOG2_HI)
+        else:
+            self._opt = _PythonFallbackOptimizer(_LOG2_LO, _LOG2_HI)
+        self._threshold = int(cfg.get(_config.FUSION_THRESHOLD))
+        self._best = (self._threshold, -1.0)
+        self._samples_done = 0
+        self._step_in_sample = 0
+        self._bytes_acc = 0
+        self._time_acc = 0.0
+        self._finished = False
+
+    # -- interface consulted by the reduction path ---------------------------
+    @property
+    def active(self) -> bool:
+        return not self._finished
+
+    @property
+    def fusion_threshold(self) -> int:
+        return self._threshold
+
+    def record(self, nbytes: int, seconds: float) -> None:
+        """Report one eager reduction step's traffic and wall time."""
+        if self._finished:
+            return
+        self._bytes_acc += int(nbytes)
+        self._time_acc += float(seconds)
+        self._step_in_sample += 1
+        if self._step_in_sample < self._steps_per_sample:
+            return
+        score = self._bytes_acc / max(self._time_acc, 1e-9)
+        self._step_in_sample = 0
+        self._bytes_acc = 0
+        self._time_acc = 0.0
+        if self._warmup_left > 0:
+            self._warmup_left -= 1
+            self._log(f"warmup threshold={self._threshold} "
+                      f"score={score:.3e} (discarded)")
+            return
+        self._observe_and_advance(score)
+
+    def _observe_and_advance(self, score: float) -> None:
+        x = math.log2(max(self._threshold, 1))
+        if score > self._best[1]:
+            self._best = (self._threshold, score)
+        self._samples_done += 1
+        self._log(f"sample {self._samples_done} threshold={self._threshold} "
+                  f"score={score:.3e} bytes/sec")
+        if self._samples_done >= self._max_samples:
+            # per-process best scores differ; rank 0's pick is adopted
+            # everywhere, like every other proposal
+            self._threshold = int(self._sync(float(self._best[0])))
+            self._finished = True
+            self._log(f"tuning complete: threshold={self._threshold} "
+                      f"score={self._best[1]:.3e}")
+        else:
+            self._opt.observe(x, score)
+            proposal = 1 << int(round(self._sync(self._opt.suggest())))
+            self._threshold = proposal
+        self._world.config.set("FUSION_THRESHOLD", self._threshold)
+
+    def _sync(self, proposal: float) -> float:
+        """Adopt rank 0's proposal in a multi-process world (reference:
+        SynchronizeParameters broadcast, controller.cc:33-47)."""
+        if self._world.num_processes <= 1:
+            return proposal
+        import numpy as np
+        from . import collectives as _c
+        out = _c.broadcast(np.array([proposal], np.float64), root_rank=0,
+                           name="hvd_tpu.autotune.param")
+        return float(np.asarray(out)[0])
+
+    def _log(self, msg: str) -> None:
+        if not self._log_path or self._world.process_id != 0:
+            return
+        try:
+            with open(self._log_path, "a") as f:
+                f.write(f"{time.strftime('%Y-%m-%d %H:%M:%S')} {msg}\n")
+        except OSError:
+            pass
+
+
+def maybe_create(world) -> Optional[ParameterManager]:
+    if not world.config.get(_config.AUTOTUNE):
+        return None
+    return ParameterManager(world)

@@ -1,0 +1,105 @@
+"""Response cache: skip re-validating steady-state submissions.
+
+TPU-native analogue of the reference ResponseCache
+(/root/reference/horovod/common/response_cache.{h,cc}): the reference caches
+negotiated Responses keyed by name+shape+dtype so steady-state training
+cycles replace the full rank-0 negotiation with two bitwise allreduces
+(CacheCoordinator::sync, response_cache.h:104-160). On TPU the expensive part
+being skipped is the cross-process metadata consistency exchange
+(collectives._check_consistency's device round-trip): a hit means this exact
+(name, shape, dtype, op) fingerprint was already validated identically on
+every process, so the exchange is skipped.
+
+Coherence argument (replaces the reference's cache-bit sync): every process
+runs the same deterministic LRU with the same capacity and sees the same
+sequence of validated submissions — a submission is only inserted *after* a
+successful cross-process validation proved all processes submitted it in the
+same step — so cache state never diverges across processes on the hit path.
+A miss on any process is at worst a redundant re-validation, never a skipped
+one, because a process only skips when *its own* cache proves prior
+validation. Capacity comes from ``HVD_TPU_CACHE_CAPACITY`` (alias
+``HOROVOD_CACHE_CAPACITY``, reference default 1024; 0 disables caching).
+
+Backed by the native LRU (csrc/cache.cc) when built, with an OrderedDict
+fallback.
+"""
+
+import collections
+import threading
+from typing import Optional
+
+from ._native import get as _native_get
+
+
+class ResponseCache:
+    def __init__(self, capacity: int):
+        self.capacity = int(capacity)
+        self._nat = _native_get()
+        self._h = None
+        if self._nat is not None:
+            self._h = self._nat.cdll.hvd_cache_create(self.capacity)
+        self._lock = threading.Lock()
+        self._lru: "collections.OrderedDict[int, None]" = \
+            collections.OrderedDict()
+
+    def __del__(self):
+        if getattr(self, "_h", None) and self._nat:
+            try:
+                self._nat.cdll.hvd_cache_destroy(self._h)
+            except Exception:
+                pass
+
+    def lookup(self, key: int) -> bool:
+        """True when `key` was previously validated (refreshes LRU order)."""
+        if self.capacity <= 0:
+            return False
+        if self._h is not None:
+            return bool(self._nat.cdll.hvd_cache_lookup(self._h, key))
+        with self._lock:
+            if key in self._lru:
+                self._lru.move_to_end(key)
+                return True
+            return False
+
+    def put(self, key: int) -> Optional[int]:
+        """Insert a validated key; returns the evicted key, if any."""
+        if self.capacity <= 0:
+            return None
+        if self._h is not None:
+            import ctypes
+            evicted = ctypes.c_uint64(0)
+            if self._nat.cdll.hvd_cache_put(self._h, key,
+                                            ctypes.byref(evicted)):
+                return int(evicted.value)
+            return None
+        with self._lock:
+            if key in self._lru:
+                self._lru.move_to_end(key)
+                return None
+            victim = None
+            if len(self._lru) >= self.capacity:
+                victim, _ = self._lru.popitem(last=False)
+            self._lru[key] = None
+            return victim
+
+    def erase(self, key: int) -> None:
+        """Invalidate one entry (reference: stalled tensors are invalidated,
+        stall_inspector.cc:31-60)."""
+        if self._h is not None:
+            self._nat.cdll.hvd_cache_erase(self._h, key)
+            return
+        with self._lock:
+            self._lru.pop(key, None)
+
+    def clear(self) -> None:
+        if self._h is not None:
+            self._nat.cdll.hvd_cache_clear(self._h)
+            return
+        with self._lock:
+            self._lru.clear()
+
+    def __len__(self) -> int:
+        if self._h is not None:
+            return int(self._nat.cdll.hvd_cache_size(self._h))
+        with self._lock:
+            return len(self._lru)

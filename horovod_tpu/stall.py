@@ -13,13 +13,19 @@ compiled SPMD world the analogous failure is a collective stuck inside a jitted
 step (peer down, DCN partition) or an eager submission never synchronized. The
 inspector watches both: entries are registered on submission and cleared on
 completion, and a daemon thread periodically reports laggards.
+
+The per-submission bookkeeping (mutexed table + steady-clock stamps) runs in
+the native runtime when built (csrc/stall.cc) so the submit path pays one
+ctypes call; the polling thread, logging and raising stay here.
 """
 
+import ctypes
 import threading
 import time
 from typing import Dict
 
 from . import config as _config
+from ._native import get as _native_get
 from .exceptions import StallError
 
 
@@ -30,6 +36,8 @@ class StallInspector:
         self._lock = threading.Lock()
         self._pending: Dict[str, float] = {}
         self._warned: Dict[str, bool] = {}
+        self._nat = _native_get()
+        self._h = self._nat.cdll.hvd_stall_create() if self._nat else None
         self._stop_evt = threading.Event()
         self._shutdown_deadline_hit = False
         self._thread = None
@@ -38,12 +46,25 @@ class StallInspector:
                 target=self._loop, name="hvd_tpu_stall", daemon=True)
             self._thread.start()
 
+    def __del__(self):
+        if getattr(self, "_h", None) and self._nat:
+            try:
+                self._nat.cdll.hvd_stall_destroy(self._h)
+            except Exception:
+                pass
+
     # -- registration --------------------------------------------------------
     def record_submit(self, name: str):
+        if self._h is not None:
+            self._nat.cdll.hvd_stall_submit(self._h, name.encode())
+            return
         with self._lock:
             self._pending.setdefault(name, time.monotonic())
 
     def record_done(self, name: str):
+        if self._h is not None:
+            self._nat.cdll.hvd_stall_done(self._h, name.encode())
+            return
         with self._lock:
             self._pending.pop(name, None)
             self._warned.pop(name, None)
@@ -63,20 +84,38 @@ class StallInspector:
         shutdown_after = self._cfg.get(_config.STALL_SHUTDOWN_TIME_SECONDS)
         poll = min(max(warn_after / 4.0, 0.25), 10.0)
         while not self._stop_evt.wait(poll):
-            now = time.monotonic()
-            with self._lock:
-                items = list(self._pending.items())
-            for name, t0 in items:
-                waited = now - t0
-                if waited > warn_after and not self._warned.get(name):
-                    self._warned[name] = True
-                    log.warning(
-                        "One or more collectives stalled for over %.0fs: %s. "
-                        "This may indicate that a peer process is down or a "
-                        "different subset of collectives was submitted on "
-                        "another process.", warn_after, name)
-                if shutdown_after > 0 and waited > shutdown_after:
-                    self._shutdown_deadline_hit = True
+            for name in self._scan(warn_after, shutdown_after):
+                log.warning(
+                    "One or more collectives stalled for over %.0fs: %s. "
+                    "This may indicate that a peer process is down or a "
+                    "different subset of collectives was submitted on "
+                    "another process.", warn_after, name)
+
+    def _scan(self, warn_after, shutdown_after):
+        """One inspection pass; returns newly-stalled names and updates the
+        shutdown flag. Native fast path when built."""
+        if self._h is not None:
+            hit = ctypes.c_int32(0)
+            buf = ctypes.create_string_buffer(1 << 16)
+            n = self._nat.cdll.hvd_stall_check(
+                self._h, float(warn_after), float(shutdown_after),
+                ctypes.byref(hit), buf, len(buf))
+            if hit.value:
+                self._shutdown_deadline_hit = True
+            return buf.value.decode().split("\n") if n > 0 and buf.value \
+                else []
+        now = time.monotonic()
+        newly = []
+        with self._lock:
+            items = list(self._pending.items())
+        for name, t0 in items:
+            waited = now - t0
+            if waited > warn_after and not self._warned.get(name):
+                self._warned[name] = True
+                newly.append(name)
+            if shutdown_after > 0 and waited > shutdown_after:
+                self._shutdown_deadline_hit = True
+        return newly
 
     def stop(self):
         self._stop_evt.set()

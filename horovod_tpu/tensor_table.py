@@ -1,6 +1,7 @@
-"""Named-tensor table and async handle management.
+"""Named-tensor table, async handle management, and the request wire format.
 
-TPU-native analogue of the reference's TensorQueue + HandleManager:
+TPU-native analogue of the reference's TensorQueue + HandleManager + message
+wire format:
 
 * the reference stages submissions in a mutex-protected ``TensorQueue`` that
   rejects duplicate in-flight names (DUPLICATE_NAME_ERROR,
@@ -8,20 +9,28 @@ TPU-native analogue of the reference's TensorQueue + HandleManager:
   background thread;
 * the Torch binding maps each submission to an integer handle resolved by a
   ``HandleManager`` (/root/reference/horovod/torch/handle_manager.{h,cc});
-* the controller validates that every rank submitted the same dtype/shape/op
-  for a given name (controller.cc:378-611).
+* submission metadata crosses the control plane as serialized ``Request``
+  messages (/root/reference/horovod/common/wire/message.fbs,
+  common/message.{h,cc}); the controller validates every rank submitted the
+  same dtype/shape/op per name (controller.cc:378-611).
 
 Here submissions dispatch through XLA immediately (JAX's async dispatch plays
-the role of the background thread + finalizer pool,
-gpu_operations.cc:60-87), so the table's jobs are: duplicate-name detection,
-handle bookkeeping, stall-inspector registration, and (optionally, knob
-``HVD_TPU_CHECK_CONSISTENCY``) cross-process metadata validation.
+the role of the background thread + finalizer pool, gpu_operations.cc:60-87),
+so the table's jobs are: duplicate-name detection, handle bookkeeping,
+stall-inspector registration, and (knob ``HVD_TPU_CHECK_CONSISTENCY``)
+cross-process metadata validation via wire-message fingerprints. The mutexed
+bookkeeping runs in the native C++ runtime when available
+(horovod_tpu/_native/csrc/table.cc) with this file as the fallback; the wire
+format has byte-identical native (csrc/wire.cc) and Python packers, so
+fingerprints agree across heterogeneous processes.
 """
 
+import struct
 import threading
 import zlib
 from typing import Any, Callable, Dict, Optional
 
+from ._native import get as _native_get
 from .exceptions import DuplicateNameError
 
 
@@ -41,37 +50,68 @@ class Handle:
 
 
 class TensorTable:
+    """Duplicate-name detection + handle allocation. Handle *objects* (whose
+    results are jax Arrays) always live on the Python side; the name/handle
+    bookkeeping lives in the native table when built."""
+
     def __init__(self, world):
         self._world = world
         self._lock = threading.Lock()
-        self._in_flight: Dict[str, int] = {}
         self._handles: Dict[int, Handle] = {}
+        nat = _native_get()
+        self._nat = nat
+        self._nat_table = nat.cdll.hvd_table_create() if nat else None
+        # pure-Python fallback state
+        self._in_flight: Dict[str, int] = {}
         self._next_handle = 0
+
+    def __del__(self):
+        if getattr(self, "_nat_table", None) and self._nat:
+            try:
+                self._nat.cdll.hvd_table_destroy(self._nat_table)
+            except Exception:
+                pass
 
     def begin(self, name: str, kind: str) -> Handle:
         """Register an in-flight named op. Raises DuplicateNameError when the
         name is already pending (reference tensor_queue.cc duplicate check)."""
-        with self._lock:
-            if name in self._in_flight:
-                raise DuplicateNameError(
-                    f"Requested to {kind} a tensor with the same name as "
-                    f"another tensor that is currently being processed: "
-                    f"{name!r}. If you want to request another tensor, pass "
-                    f"a different name.")
-            hid = self._next_handle
-            self._next_handle += 1
-            h = Handle(hid, name)
-            self._in_flight[name] = hid
-            self._handles[hid] = h
+        if self._nat_table is not None:
+            hid = self._nat.cdll.hvd_table_begin(
+                self._nat_table, name.encode())
+            if hid < 0:
+                raise DuplicateNameError(self._dup_msg(kind, name))
+            h = Handle(int(hid), name)
+            with self._lock:
+                self._handles[h.id] = h
+        else:
+            with self._lock:
+                if name in self._in_flight:
+                    raise DuplicateNameError(self._dup_msg(kind, name))
+                hid = self._next_handle
+                self._next_handle += 1
+                h = Handle(hid, name)
+                self._in_flight[name] = hid
+                self._handles[hid] = h
         insp = self._world.stall_inspector
         if insp is not None:
             insp.record_submit(name)
         return h
 
+    @staticmethod
+    def _dup_msg(kind: str, name: str) -> str:
+        return (f"Requested to {kind} a tensor with the same name as another "
+                f"tensor that is currently being processed: {name!r}. If you "
+                f"want to request another tensor, pass a different name.")
+
     def finish(self, handle: Handle):
-        with self._lock:
-            self._in_flight.pop(handle.name, None)
-            self._handles.pop(handle.id, None)
+        if self._nat_table is not None:
+            self._nat.cdll.hvd_table_finish(self._nat_table, handle.id)
+            with self._lock:
+                self._handles.pop(handle.id, None)
+        else:
+            with self._lock:
+                self._in_flight.pop(handle.name, None)
+                self._handles.pop(handle.id, None)
         insp = self._world.stall_inspector
         if insp is not None:
             insp.record_done(handle.name)
@@ -84,13 +124,108 @@ class TensorTable:
         return h
 
     def pending_count(self) -> int:
+        if self._nat_table is not None:
+            return int(self._nat.cdll.hvd_table_pending(self._nat_table))
         with self._lock:
             return len(self._in_flight)
 
 
-def metadata_fingerprint(name: str, shape, dtype, kind: str, extra: str = "") -> int:
-    """Stable 32-bit fingerprint of a submission's metadata, used for the
-    cross-process consistency check (the TPU-shaped stand-in for the
-    reference controller's per-cycle dtype/shape validation)."""
-    key = f"{name}|{tuple(shape)}|{dtype}|{kind}|{extra}".encode()
-    return zlib.crc32(key)
+# ---------------------------------------------------------------------------
+# Request wire format (fixed little-endian layout shared with csrc/wire.cc):
+#   u8 version=1 | i32 rank | u8 kind_len,kind | u16 name_len,name
+#   | u8 dtype_len,dtype | u8 ndim, i64 dims[ndim] | u16 extra_len,extra
+# ---------------------------------------------------------------------------
+
+WIRE_VERSION = 1
+
+
+def pack_request(name: str, shape, dtype, kind: str, extra: str = "",
+                 rank: int = 0) -> bytes:
+    """Serialize submission metadata. Byte-identical to the native packer
+    (wire.cc hvd_wire_pack_request) so CRCs agree across processes regardless
+    of which implementation each one runs."""
+    nb = name.encode()
+    db = str(dtype).encode()
+    kb = kind.encode()
+    eb = extra.encode()
+    dims = tuple(int(d) for d in shape)
+    if len(nb) > 0xFFFF or len(db) > 0xFF or len(kb) > 0xFF \
+            or len(eb) > 0xFFFF or len(dims) > 0xFF:
+        raise ValueError("request metadata field too large for wire format")
+    parts = [struct.pack("<Bi", WIRE_VERSION, rank),
+             struct.pack("<B", len(kb)), kb,
+             struct.pack("<H", len(nb)), nb,
+             struct.pack("<B", len(db)), db,
+             struct.pack("<B", len(dims))]
+    parts += [struct.pack("<q", d) for d in dims]
+    parts += [struct.pack("<H", len(eb)), eb]
+    return b"".join(parts)
+
+
+def unpack_request(buf: bytes) -> dict:
+    """Parse a wire message back into its fields (native parser when built)."""
+    nat = _native_get()
+    if nat is not None:
+        import ctypes
+        name = ctypes.create_string_buffer(65536)
+        dtype = ctypes.create_string_buffer(256)
+        kind = ctypes.create_string_buffer(256)
+        extra = ctypes.create_string_buffer(65536)
+        shape = (ctypes.c_int64 * 255)()
+        ndim = ctypes.c_int32(255)
+        rank = ctypes.c_int32(0)
+        n = nat.cdll.hvd_wire_unpack_request(
+            buf, len(buf), name, len(name), shape, ctypes.byref(ndim),
+            dtype, len(dtype), kind, len(kind), extra, len(extra),
+            ctypes.byref(rank))
+        if n < 0:
+            raise ValueError("malformed wire message")
+        return {"name": name.value.decode(), "kind": kind.value.decode(),
+                "dtype": dtype.value.decode(), "extra": extra.value.decode(),
+                "shape": tuple(shape[i] for i in range(ndim.value)),
+                "rank": int(rank.value)}
+    # pure-Python parser (same error contract as the native one: any
+    # malformed or truncated message raises ValueError)
+    off = 0
+
+    def take(fmt):
+        nonlocal off
+        try:
+            vals = struct.unpack_from(fmt, buf, off)
+        except struct.error as e:
+            raise ValueError("malformed wire message") from e
+        off += struct.calcsize(fmt)
+        return vals
+
+    def take_str(n):
+        nonlocal off
+        if off + n > len(buf):
+            raise ValueError("malformed wire message")
+        s = buf[off:off + n].decode()
+        off += n
+        return s
+
+    version, rank = take("<Bi")
+    if version != WIRE_VERSION:
+        raise ValueError("malformed wire message")
+    kind = take_str(take("<B")[0])
+    name = take_str(take("<H")[0])
+    dtype = take_str(take("<B")[0])
+    (ndim,) = take("<B")
+    shape = tuple(take("<q")[0] for _ in range(ndim))
+    extra = take_str(take("<H")[0])
+    return {"name": name, "kind": kind, "dtype": dtype, "extra": extra,
+            "shape": shape, "rank": rank}
+
+
+def metadata_fingerprint(name: str, shape, dtype, kind: str,
+                         extra: str = "") -> int:
+    """Stable 32-bit fingerprint of a submission's metadata: CRC-32 of the
+    wire message (rank excluded so all ranks agree). Used for the
+    cross-process consistency check — the TPU-shaped stand-in for the
+    reference controller's per-cycle dtype/shape validation."""
+    msg = pack_request(name, shape, dtype, kind, extra, rank=0)
+    nat = _native_get()
+    if nat is not None:
+        return int(nat.cdll.hvd_crc32(msg, len(msg)))
+    return zlib.crc32(msg)

@@ -20,6 +20,7 @@ import time
 from typing import Optional
 
 from . import config as _config
+from ._native import get as _native_get
 
 # Host-side activity names, mirroring the reference's
 # (/root/reference/horovod/common/common.h:31-59).
@@ -38,7 +39,14 @@ NEGOTIATE = "NEGOTIATE"
 
 class Timeline:
     """Thread-safe chrome-tracing writer. All public methods are cheap when
-    disabled (no-op guard on first line)."""
+    disabled (no-op guard on first line).
+
+    When the native runtime is built, formatting, timestamps and the writer
+    thread live in C++ (csrc/timeline.cc, the analogue of the reference's
+    TimelineWriter thread); this class then only maps the per-tensor state
+    machine onto native emit calls. Without native, the in-Python writer
+    thread below does the same job.
+    """
 
     def __init__(self, path: str, mark_cycles: bool = False):
         self._path = path
@@ -49,9 +57,19 @@ class Timeline:
         self._lock = threading.Lock()
         self._t0 = time.perf_counter()
         self._closed = False
-        self._thread = threading.Thread(
-            target=self._writer, name="hvd_tpu_timeline", daemon=True)
-        self._thread.start()
+        self._nat = _native_get()
+        self._h = None
+        # serializes native emit vs close: close() frees the C++ object, so
+        # no emitter may be inside hvd_tl_emit when it runs
+        self._native_lock = threading.Lock()
+        if self._nat is not None:
+            self._h = self._nat.cdll.hvd_tl_create(path.encode())
+        self._thread = None
+        if self._h is None:
+            self._nat = None
+            self._thread = threading.Thread(
+                target=self._writer, name="hvd_tpu_timeline", daemon=True)
+            self._thread.start()
 
     @property
     def enabled(self) -> bool:
@@ -61,6 +79,12 @@ class Timeline:
         return (time.perf_counter() - self._t0) * 1e6
 
     def _tid(self, tensor_name: str) -> int:
+        if self._h is not None:
+            with self._native_lock:
+                if self._h is None:
+                    return 0
+                return int(self._nat.cdll.hvd_tl_tid(
+                    self._h, tensor_name.encode()))
         with self._lock:
             tid = self._tids.get(tensor_name)
             if tid is None:
@@ -73,6 +97,15 @@ class Timeline:
 
     def _emit(self, name, ph, tensor_name, args=None):
         if self._closed:
+            return
+        if self._h is not None:
+            tid = self._tid(tensor_name)
+            with self._native_lock:
+                if self._h is None:
+                    return
+                self._nat.cdll.hvd_tl_emit(
+                    self._h, name.encode(), ph.encode(), tid,
+                    json.dumps(args).encode() if args else None)
             return
         ev = {"name": name, "ph": ph, "pid": 0, "tid": self._tid(tensor_name),
               "ts": self._now_us()}
@@ -101,6 +134,13 @@ class Timeline:
         # chrome tracing closes the innermost open B for this tid
         if self._closed:
             return
+        if self._h is not None:
+            tid = self._tid(tensor_name)
+            with self._native_lock:
+                if self._h is None:
+                    return
+                self._nat.cdll.hvd_tl_emit(self._h, b"", b"E", tid, None)
+            return
         self._q.put({"name": "", "ph": "E", "pid": 0,
                      "tid": self._tid(tensor_name), "ts": self._now_us()})
 
@@ -109,6 +149,13 @@ class Timeline:
 
     def mark_cycle(self):
         if self._mark_cycles and not self._closed:
+            if self._h is not None:
+                with self._native_lock:
+                    if self._h is None:
+                        return
+                    self._nat.cdll.hvd_tl_emit(
+                        self._h, b"CYCLE", b"i", 0, None)
+                return
             self._q.put({"name": "CYCLE", "ph": "i", "pid": 0, "tid": 0,
                          "ts": self._now_us(), "s": "g"})
 
@@ -146,6 +193,11 @@ class Timeline:
         if self._closed:
             return
         self._closed = True
+        if self._h is not None:
+            with self._native_lock:
+                h, self._h = self._h, None
+            self._nat.cdll.hvd_tl_close(h)
+            return
         self._q.put(None)
         self._thread.join(timeout=10)
 
