@@ -155,17 +155,20 @@ class JaxState(ObjectState):
         import jax
         import numpy as np
 
-        def leaf(l):
-            if isinstance(l, (jax.Array, np.ndarray)):
-                return jax.device_put(l)
-            return l
+        def is_arr(l):
+            return isinstance(l, (jax.Array, np.ndarray))
 
-        if self._sharding is not None:
+        # whole-tree device_put with the target sharding only for pure-array
+        # pytrees; plain scalars (epoch/batch counters) must stay Python
+        # values — promoting them to jax.Arrays breaks hashing/serialization
+        leaves = jax.tree_util.tree_leaves(value)
+        if self._sharding is not None and leaves and all(map(is_arr, leaves)):
             try:
                 return jax.device_put(value, self._sharding)
             except (TypeError, ValueError):
                 pass
-        return jax.tree_util.tree_map(leaf, value)
+        return jax.tree_util.tree_map(
+            lambda l: jax.device_put(l) if is_arr(l) else l, value)
 
     def save(self) -> None:
         self._saved_state = {

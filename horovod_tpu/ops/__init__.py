@@ -1,0 +1,11 @@
+"""Pallas TPU kernels for hot ops.
+
+The reference's hot path is hand-written CUDA/NCCL
+(/root/reference/horovod/common/ops/); the TPU build's hot paths are XLA
+collectives plus Pallas kernels for the ops XLA doesn't schedule optimally.
+"""
+
+from .flash_attention import (  # noqa: F401
+    flash_attention, flash_attention_with_lse, mha_reference,
+    use_pallas_default,
+)

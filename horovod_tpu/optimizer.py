@@ -117,8 +117,11 @@ class DistributedGradientTransform:
             else w.config.get(_config.FUSION_THRESHOLD)
         leaves, treedef = jax.tree_util.tree_flatten(grads)
         self._step += 1
-        names = [f"{self._prefix}.grad.{self._step}.{i}"
-                 for i in range(len(leaves))]
+        # stable names across steps: the ResponseCache fast path and the
+        # reference's per-parameter naming (torch/optimizer.py:111-117) both
+        # key on them; duplicate in-flight protection comes from the
+        # TensorTable, and each bucket completes before the next begins
+        names = [f"{self._prefix}.grad.{i}" for i in range(len(leaves))]
 
         def fused(bucket_vals, bucket_names):
             comp = [self._compression.compress(v) for v in bucket_vals]

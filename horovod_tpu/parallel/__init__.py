@@ -21,7 +21,9 @@ framework supplies the full set as first-class, mesh-native components:
 
 from .mesh_utils import MeshConfig, make_training_mesh, TRANSFORMER_RULES  # noqa: F401
 from .hierarchical import hierarchical_allreduce, hierarchical_pmean  # noqa: F401
-from .ring_attention import ring_attention  # noqa: F401
+from .ring_attention import (  # noqa: F401
+    ring_attention, ring_attention_flash,
+)
 from .ulysses import ulysses_attention  # noqa: F401
 from .pipeline import pipeline_apply  # noqa: F401
 from .moe import MoEMlp, moe_mlp, route_top1  # noqa: F401

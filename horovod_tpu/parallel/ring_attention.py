@@ -29,15 +29,23 @@ NEG_INF = -1e30
 
 
 def ring_attention(q, k, v, axis_name: str, causal: bool = True,
-                   out_dtype=None):
+                   out_dtype=None, impl: str = "auto"):
     """Exact attention over sequence blocks distributed on ``axis_name``.
 
     Args:
       q, k, v: (B, S_local, H, D) per-device blocks (sequence axis sharded).
       axis_name: mesh axis carrying the sequence shards (the ring).
       causal: apply a causal mask using global positions.
+      impl: "flash" = Pallas flash kernel per ring step (TPU hot path),
+        "xla" = blockwise einsum recurrence, "auto" = flash on TPU.
     Returns (B, S_local, H, D) attention output for the local Q block.
     """
+    if impl == "auto":
+        from ..ops.flash_attention import use_pallas_default
+        impl = "flash" if use_pallas_default() else "xla"
+    if impl == "flash":
+        return ring_attention_flash(q, k, v, axis_name, causal=causal,
+                                    out_dtype=out_dtype)
     out_dtype = out_dtype or q.dtype
     n = jax.lax.axis_size(axis_name)
     my = jax.lax.axis_index(axis_name)
@@ -87,6 +95,70 @@ def ring_attention(q, k, v, axis_name: str, causal: bool = True,
     l = jnp.maximum(l, 1e-30)
     out = o / l.transpose(0, 2, 1)[..., None]
     return out.astype(out_dtype)
+
+
+def ring_attention_flash(q, k, v, axis_name: str, causal: bool = True,
+                         out_dtype=None, interpret=None,
+                         block_q: int = 512, block_k: int = 128):
+    """Ring attention with the Pallas flash kernel as the per-step block
+    engine (ops/flash_attention.py).
+
+    Each ring step computes this device's Q block against the currently-held
+    K/V block with the flash kernel — which returns (out_i, lse_i), both
+    differentiable — and merges the partials with the standard log-sum-exp
+    combine::
+
+        lse' = logaddexp(lse, lse_i)
+        o'   = o * exp(lse - lse') + o_i * exp(lse_i - lse')
+
+    Steps whose K block is entirely in the causal future yield lse_i ~ -1e30
+    and contribute exp(-big) = 0, so the merge is uniform (no data-dependent
+    control flow — one compiled SPMD program). ``jax.checkpoint`` wraps the
+    step so the backward re-runs the kernel instead of storing every rotated
+    K/V block — memory stays O(S_local) like the forward, the standard ring
+    attention trade.
+    """
+    out_dtype = out_dtype or q.dtype
+    from ..ops.flash_attention import (flash_attention_with_lse,
+                                       use_pallas_default)
+    if interpret is None:
+        interpret = not use_pallas_default()
+    n = jax.lax.axis_size(axis_name)
+    my = jax.lax.axis_index(axis_name)
+    B, S, H, D = q.shape
+    perm = [(j, (j + 1) % n) for j in range(n)]
+
+    def step(i, o, lse, k_blk, v_blk):
+        src = (my - i) % n
+        o_i, lse_i = flash_attention_with_lse(
+            q, k_blk, v_blk, causal=causal,
+            q_offset=my * S, k_offset=src * S,
+            block_q=block_q, block_k=block_k, interpret=interpret,
+            out_dtype=jnp.float32, vma=(axis_name,))
+        lse_new = jnp.logaddexp(lse, lse_i)
+        w_old = jnp.exp(lse - lse_new)[..., None]        # (B, S, H, 1)
+        w_new = jnp.exp(lse_i - lse_new)[..., None]
+        o = o * w_old + o_i * w_new
+        if i + 1 < n:  # final rotation unnecessary
+            k_blk = jax.lax.ppermute(k_blk, axis_name, perm)
+            v_blk = jax.lax.ppermute(v_blk, axis_name, perm)
+        return o, lse_new, k_blk, v_blk
+
+    # remat each step on the compiled path: the backward re-runs the kernel
+    # instead of storing every rotated K/V block, keeping memory O(S_local)
+    if not interpret:
+        step = jax.checkpoint(step, static_argnums=(0,))
+
+    def vary(x):
+        return jax.lax.pcast(x, (axis_name,), to="varying")
+    o = vary(jnp.zeros((B, S, H, D), jnp.float32))
+    lse = vary(jnp.full((B, S, H), NEG_INF, jnp.float32))
+    k_blk, v_blk = k, v
+    # unrolled ring (n is static = axis size): one pallas call per step,
+    # ppermute overlapped with the next step's compute by XLA's scheduler
+    for i in range(n):
+        o, lse, k_blk, v_blk = step(i, o, lse, k_blk, v_blk)
+    return o.astype(out_dtype)
 
 
 def make_ring_attention(axis_name: str, causal: bool = True):

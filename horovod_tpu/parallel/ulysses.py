@@ -38,14 +38,26 @@ def ulysses_attention(q, k, v, axis_name: str, causal: bool = True,
         here unchanged.
     Returns (B, S_local, H, D).
     """
-    from horovod_tpu.models.transformer import _default_attention
     out_dtype = out_dtype or q.dtype
     n = jax.lax.axis_size(axis_name)
     H = q.shape[2]
     if H % n != 0:
         raise ValueError(f"num_heads {H} not divisible by '{axis_name}' "
                          f"axis size {n}; use ring_attention instead")
-    attention_fn = attention_fn or _default_attention
+    if attention_fn is None:
+        from horovod_tpu.ops.flash_attention import use_pallas_default
+        if use_pallas_default():
+            # after the all_to_all each device holds the full sequence for
+            # its head subset — exactly the flash kernel's shape
+            from horovod_tpu.ops.flash_attention import flash_attention
+
+            def attention_fn(qh, kh, vh, mask, dtype):
+                del mask  # causal handled inside the kernel
+                return flash_attention(qh, kh, vh, causal=causal,
+                                       out_dtype=dtype, vma=(axis_name,))
+        else:
+            from horovod_tpu.models.transformer import _default_attention
+            attention_fn = _default_attention
     qh = _seq_to_heads(q, axis_name)
     kh = _seq_to_heads(k, axis_name)
     vh = _seq_to_heads(v, axis_name)
