@@ -59,6 +59,18 @@ def __getattr__(name):
     if name == "Compression":
         from .compression import Compression
         return Compression
+    if name in ("SyncBatchNorm", "sync_batch_norm_stats"):
+        from . import sync_batch_norm
+        return getattr(sync_batch_norm, name)
+    if name in ("SparseGradient", "allreduce_sparse",
+                "allreduce_sparse_as_dense", "sparse_to_dense"):
+        from . import sparse
+        return getattr(sparse, name)
+    if name in ("callbacks", "torch"):
+        # importlib, not `from . import x`: the fromlist lookup re-enters
+        # this __getattr__ before sys.modules is populated (see `elastic`)
+        import importlib
+        return importlib.import_module("." + name, __name__)
     if name == "elastic":
         # NOT `from . import elastic`: the fromlist lookup re-enters this
         # __getattr__ before sys.modules is populated -> infinite recursion.

@@ -1,0 +1,217 @@
+"""Training-loop callbacks for distributed runs.
+
+Reference surface: the Keras callback family
+(/root/reference/horovod/_keras/callbacks.py:22-190 —
+BroadcastGlobalVariablesCallback, MetricAverageCallback,
+LearningRateScheduleCallback, LearningRateWarmupCallback). TPU-native
+redesign: there is no Keras model object mutating an optimizer variable, so
+callbacks operate on an explicit :class:`TrainingRun` record that the user's
+loop threads through the hooks — params pytree in, params pytree out, and a
+``lr_scale`` the loop multiplies into its learning rate (compose with optax
+via :func:`scaled_schedule`). Hook protocol and semantics (staircase vs
+continuous schedules, warmup formula, averaging metric logs in place) match
+the reference.
+
+Typical loop::
+
+    run = hvd.callbacks.TrainingRun(params=params, steps_per_epoch=spe)
+    cbs = hvd.callbacks.CallbackList(
+        [hvd.callbacks.BroadcastGlobalVariablesCallback(0),
+         hvd.callbacks.LearningRateWarmupCallback(warmup_epochs=5),
+         hvd.callbacks.MetricAverageCallback()], run)
+    cbs.on_train_begin()
+    for epoch in range(E):
+        cbs.on_epoch_begin(epoch)
+        for batch in range(spe):
+            cbs.on_batch_begin(batch)
+            params, opt_state, logs = step(params, opt_state,
+                                           lr_scale=run.lr_scale)
+            run.params = params
+            cbs.on_batch_end(batch, logs)
+        cbs.on_epoch_end(epoch, logs)
+"""
+
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional
+
+import numpy as np
+
+
+@dataclass
+class TrainingRun:
+    """Mutable record the callbacks read and write."""
+    params: Any = None                  # model pytree (broadcast target)
+    steps_per_epoch: Optional[int] = None
+    lr_scale: float = 1.0               # multiplied into the loop's LR
+    epoch: int = 0
+    extra_state: Dict[str, Any] = field(default_factory=dict)
+
+
+class Callback:
+    run: TrainingRun = None  # set by CallbackList
+
+    def on_train_begin(self, logs=None):
+        pass
+
+    def on_epoch_begin(self, epoch: int, logs=None):
+        pass
+
+    def on_batch_begin(self, batch: int, logs=None):
+        pass
+
+    def on_batch_end(self, batch: int, logs=None):
+        pass
+
+    def on_epoch_end(self, epoch: int, logs=None):
+        pass
+
+
+class CallbackList:
+    def __init__(self, callbacks: List[Callback], run: TrainingRun):
+        self.callbacks = list(callbacks)
+        self.run = run
+        for cb in self.callbacks:
+            cb.run = run
+
+    def __iter__(self):
+        return iter(self.callbacks)
+
+    def _fire(self, hook, *args, **kw):
+        for cb in self.callbacks:
+            getattr(cb, hook)(*args, **kw)
+
+    def on_train_begin(self, logs=None):
+        self._fire("on_train_begin", logs)
+
+    def on_epoch_begin(self, epoch, logs=None):
+        self.run.epoch = epoch
+        self._fire("on_epoch_begin", epoch, logs)
+
+    def on_batch_begin(self, batch, logs=None):
+        self._fire("on_batch_begin", batch, logs)
+
+    def on_batch_end(self, batch, logs=None):
+        self._fire("on_batch_end", batch, logs)
+
+    def on_epoch_end(self, epoch, logs=None):
+        self._fire("on_epoch_end", epoch, logs)
+
+
+class BroadcastGlobalVariablesCallback(Callback):
+    """Broadcast ``run.params`` from ``root_rank`` once, at the start of
+    training (reference: _keras/callbacks.py:22-46 — broadcast on first
+    batch so late-restored checkpoints win)."""
+
+    def __init__(self, root_rank: int = 0):
+        self.root_rank = root_rank
+        self._done = False
+
+    def on_batch_end(self, batch, logs=None):
+        if self._done:
+            return
+        from .functions import broadcast_parameters
+        self.run.params = broadcast_parameters(
+            self.run.params, root_rank=self.root_rank)
+        self._done = True
+
+
+class MetricAverageCallback(Callback):
+    """Average the epoch-end metric logs across processes in place
+    (reference: _keras/callbacks.py:48-87). Metrics reduce in sorted-name
+    order so every process submits the same collective sequence."""
+
+    def on_epoch_end(self, epoch, logs=None):
+        if not logs:
+            return
+        from . import collectives as _c
+        for metric in sorted(logs):
+            value = logs[metric]
+            if isinstance(value, (int, float, np.floating, np.integer)) or (
+                    hasattr(value, "shape") and np.ndim(value) == 0):
+                out = _c.allreduce(np.asarray(value, np.float64),
+                                   op=_c.Average,
+                                   name=f"metric.{metric}")
+                logs[metric] = float(np.asarray(out))
+
+
+class LearningRateScheduleCallback(Callback):
+    """Scale the loop's LR by ``multiplier(epoch)`` within
+    [start_epoch, end_epoch) (reference: _keras/callbacks.py:90-166).
+    ``staircase`` updates once per epoch; otherwise the epoch is fractional
+    per batch (needs ``run.steps_per_epoch``)."""
+
+    def __init__(self, multiplier, start_epoch: int = 0,
+                 end_epoch: Optional[int] = None, staircase: bool = True):
+        self.start_epoch = start_epoch
+        self.end_epoch = end_epoch
+        if not callable(multiplier):
+            self.staircase = True
+            self.multiplier: Callable[[float], float] = lambda e: multiplier
+        else:
+            self.staircase = staircase
+            self.multiplier = multiplier
+
+    def on_batch_begin(self, batch, logs=None):
+        epoch = self.run.epoch
+        if epoch < self.start_epoch or (
+                self.end_epoch is not None and epoch >= self.end_epoch):
+            return
+        if self.staircase:
+            if batch == 0:
+                self.run.lr_scale = float(self.multiplier(epoch))
+        else:
+            spe = self.run.steps_per_epoch
+            if not spe:
+                raise ValueError(
+                    "non-staircase schedules need TrainingRun."
+                    "steps_per_epoch (reference: _autodetect_steps_per_epoch)")
+            self.run.lr_scale = float(self.multiplier(epoch + batch / spe))
+
+    def on_epoch_end(self, epoch, logs=None):
+        if logs is not None:
+            logs["lr_scale"] = self.run.lr_scale
+
+
+class LearningRateWarmupCallback(LearningRateScheduleCallback):
+    """Gradual warmup from 1x to dp_size()x LR over ``warmup_epochs``
+    (reference: _keras/callbacks.py:169-190, formula from Goyal et al.
+    "Accurate, Large Minibatch SGD"). The scale starts near 1/size (so
+    base_lr * size * scale ~ base_lr) and reaches 1."""
+
+    def __init__(self, warmup_epochs: float = 5, verbose: int = 0,
+                 size: Optional[int] = None):
+        self._size = size
+        self.verbose = verbose
+
+        def multiplier(epoch):
+            n = self._world_size()
+            epoch += 1.0 / (self.run.steps_per_epoch or 1)
+            return 1.0 / n * (epoch * (n - 1) / warmup_epochs + 1)
+        super().__init__(multiplier, start_epoch=0, end_epoch=warmup_epochs,
+                         staircase=False)
+
+    def _world_size(self) -> int:
+        if self._size is not None:
+            return self._size
+        from . import basics
+        return basics.dp_size() if basics.is_initialized() else 1
+
+    def on_epoch_end(self, epoch, logs=None):
+        super().on_epoch_end(epoch, logs)
+        if self.verbose and epoch == (self.end_epoch or 0) - 1:
+            import logging
+            logging.getLogger("horovod_tpu").info(
+                "Epoch %d: finished gradual learning rate warmup to scale "
+                "%.4f.", epoch + 1, self.run.lr_scale)
+
+
+def scaled_schedule(base_schedule, run: TrainingRun):
+    """Wrap an optax schedule (or constant) so callback LR scaling applies:
+    ``lr(step) = base(step) * run.lr_scale``. The scale is read at call
+    time, so pass the resulting schedule via optax.inject_hyperparams or
+    rebuild the optimizer per epoch when running fully jitted."""
+    def schedule(count):
+        base = base_schedule(count) if callable(base_schedule) \
+            else base_schedule
+        return base * run.lr_scale
+    return schedule

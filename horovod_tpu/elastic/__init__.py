@@ -38,3 +38,6 @@ from .discovery import (  # noqa: F401
 )
 from .registration import WorkerStateRegistry, READY, SUCCESS, FAILURE  # noqa: F401
 from .driver import ElasticDriver  # noqa: F401
+from .callbacks import (  # noqa: F401
+    CommitStateCallback, UpdateBatchStateCallback, UpdateEpochStateCallback,
+)

@@ -1,0 +1,76 @@
+"""Sparse (embedding-style) gradient reduction.
+
+Reference: TF IndexedSlices gradients are allreduced by allgathering values
+and indices across ranks (/root/reference/horovod/tensorflow/
+__init__.py:87-102 `_allreduce_cond` sparse branch), because summing ragged
+index sets is cheaper as a gather; Torch exposes
+``sparse_as_dense`` to densify instead (torch/optimizer.py DistributedOptimizer
+argument). Both surfaces exist here:
+
+* :func:`allreduce_sparse` — gather-based: returns the concatenated
+  (indices, values) pairs from every process, values pre-divided for
+  Average. Duplicate indices are legal (the consumer scatter-adds), exactly
+  like TF IndexedSlices semantics.
+* :func:`sparse_to_dense` / :func:`allreduce_sparse_as_dense` — densify and
+  ride the dense allreduce (HOROVOD_SPARSE_AS_DENSE semantics).
+
+On the compiled plane, embedding gradients under pjit are handled by XLA's
+scatter fusion and need no special casing — these helpers serve the eager
+host plane.
+"""
+
+from typing import NamedTuple, Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+
+class SparseGradient(NamedTuple):
+    """IndexedSlices-shaped triple: ``values[i]`` is the gradient row for
+    ``dense_shape``-indexed row ``indices[i]``."""
+    indices: jnp.ndarray    # (nnz,) int
+    values: jnp.ndarray     # (nnz, ...) rows
+    dense_shape: tuple
+
+
+def allreduce_sparse(sparse: SparseGradient, average: bool = True,
+                     name: Optional[str] = None,
+                     process_set=None) -> SparseGradient:
+    """Allreduce of a sparse gradient by double allgather (reference:
+    tensorflow/__init__.py:87-102). Per-process nnz may differ (ragged
+    allgather). Returns the global (indices, values) with values scaled by
+    1/size when ``average``."""
+    from . import basics as _basics
+    from . import collectives as _c
+    w = _basics.world()
+    name = name or "horovod_tpu.sparse"
+    values = jnp.asarray(sparse.values)
+    if average:
+        wm = process_set or w.world_mesh
+        values = values / wm.num_procs
+    gathered_values = _c.allgather(values, name=name + ".values",
+                                   process_set=process_set)
+    gathered_indices = _c.allgather(jnp.asarray(sparse.indices),
+                                    name=name + ".indices",
+                                    process_set=process_set)
+    return SparseGradient(gathered_indices, gathered_values,
+                          sparse.dense_shape)
+
+
+def sparse_to_dense(sparse: SparseGradient) -> jnp.ndarray:
+    """Scatter-add the rows into a dense array (duplicate indices sum)."""
+    dense = jnp.zeros(sparse.dense_shape, sparse.values.dtype)
+    return dense.at[sparse.indices].add(sparse.values)
+
+
+def allreduce_sparse_as_dense(sparse: SparseGradient, average: bool = True,
+                              name: Optional[str] = None,
+                              process_set=None) -> jnp.ndarray:
+    """Densify then dense-allreduce (reference sparse_as_dense knob,
+    torch/optimizer.py). Better when nnz approaches the dense size."""
+    from . import collectives as _c
+    dense = sparse_to_dense(sparse)
+    op = _c.Average if average else _c.Sum
+    return _c.allreduce(dense, op=op,
+                        name=name or "horovod_tpu.sparse.dense",
+                        process_set=process_set)

@@ -1,0 +1,108 @@
+"""Cross-replica (synchronized) batch normalization.
+
+Reference surface: ``hvd.SyncBatchNormalization`` (TF:
+/root/reference/horovod/tensorflow/sync_batch_norm.py — allreduces batch
+mean and variance across ranks) and ``hvd.SyncBatchNorm`` (Torch:
+/root/reference/horovod/torch/sync_batch_norm.py:199 — allgathers per-rank
+sums/counts inside the autograd function). TPU-native redesign, two planes:
+
+* **Compiled plane** (:class:`SyncBatchNorm`): a flax module whose batch
+  statistics are ``lax.pmean``-reduced over the data-parallel mesh axes
+  inside the jitted step — one fused XLA collective, the moral equivalent of
+  the reference's allreduce-of-mean/var. Works under shard_map or pjit; with
+  ``axis_name=None`` it degrades to plain BatchNorm (size-1 semantics, like
+  the reference with one process).
+* **Eager plane** (:func:`sync_batch_norm_stats`): computes globally-pooled
+  mean/var across processes with the host-plane allreduce, for callers
+  maintaining their own normalization (reference torch pattern of syncing
+  running stats).
+
+Variance is synchronized via E[x^2] - E[x]^2 of the *global* batch — the
+same math the reference uses (sync_batch_norm.py: allreduce of mean and of
+mean-of-squares), exact for equal per-replica batch sizes (SPMD guarantees
+that on TPU).
+"""
+
+from typing import Any, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+import flax.linen as nn
+
+
+class SyncBatchNorm(nn.Module):
+    """BatchNorm whose statistics are exact over the global batch.
+
+    Attributes mirror flax.linen.BatchNorm; ``axis_name`` is the mesh axis
+    (or axes) carrying data parallelism. Use exactly like BatchNorm::
+
+        SyncBatchNorm(axis_name="dp", use_running_average=not train)(x)
+    """
+
+    axis_name: Optional[Union[str, Sequence[str]]] = None
+    use_running_average: bool = False
+    momentum: float = 0.99
+    epsilon: float = 1e-5
+    dtype: Optional[Any] = None
+    use_bias: bool = True
+    use_scale: bool = True
+
+    @nn.compact
+    def __call__(self, x):
+        features = x.shape[-1]
+        reduce_axes = tuple(range(x.ndim - 1))
+        dtype = self.dtype or x.dtype
+
+        ra_mean = self.variable(
+            "batch_stats", "mean", lambda: jnp.zeros(features, jnp.float32))
+        ra_var = self.variable(
+            "batch_stats", "var", lambda: jnp.ones(features, jnp.float32))
+
+        if self.use_running_average:
+            mean, var = ra_mean.value, ra_var.value
+        else:
+            xf = x.astype(jnp.float32)
+            mean = jnp.mean(xf, axis=reduce_axes)
+            mean_sq = jnp.mean(jnp.square(xf), axis=reduce_axes)
+            if self.axis_name is not None and not self.is_initializing():
+                # one fused cross-replica reduction of (mean, E[x^2]) —
+                # reference: allreduce of mean and var,
+                # tensorflow/sync_batch_norm.py. Skipped during init(),
+                # which typically runs outside shard_map (axis unbound).
+                mean, mean_sq = jax.lax.pmean(
+                    (mean, mean_sq), axis_name=self.axis_name)
+            var = jnp.maximum(mean_sq - jnp.square(mean), 0.0)
+            if not self.is_initializing():
+                m = self.momentum
+                ra_mean.value = m * ra_mean.value + (1 - m) * mean
+                ra_var.value = m * ra_var.value + (1 - m) * var
+
+        y = (x.astype(jnp.float32) - mean) * jax.lax.rsqrt(var + self.epsilon)
+        if self.use_scale:
+            scale = self.param("scale", nn.initializers.ones, (features,),
+                               jnp.float32)
+            y = y * scale
+        if self.use_bias:
+            bias = self.param("bias", nn.initializers.zeros, (features,),
+                              jnp.float32)
+            y = y + bias
+        return y.astype(dtype)
+
+
+def sync_batch_norm_stats(x, process_set=None) -> Tuple[jnp.ndarray,
+                                                        jnp.ndarray]:
+    """Eager-plane global batch statistics: (mean, biased var) of ``x``
+    pooled over all processes (reduce axes = all but last). Equal
+    per-process batch sizes assumed, as in the reference's allreduce-of-
+    means formulation."""
+    from . import collectives as _c
+    xf = jnp.asarray(x, jnp.float32)
+    axes = tuple(range(xf.ndim - 1))
+    local = jnp.stack([jnp.mean(xf, axis=axes),
+                       jnp.mean(jnp.square(xf), axis=axes)])
+    glob = _c.allreduce(local, op=_c.Average,
+                        name="horovod_tpu.sync_bn.stats",
+                        process_set=process_set)
+    mean, mean_sq = jnp.asarray(glob)[0], jnp.asarray(glob)[1]
+    return mean, jnp.maximum(mean_sq - jnp.square(mean), 0.0)

@@ -1,0 +1,231 @@
+"""PyTorch interop: train torch models with the TPU-hosted collective plane.
+
+Reference surface: horovod/torch — ``DistributedOptimizer`` registering
+per-parameter grad hooks that fire async allreduces, synchronized in
+``step()`` (/root/reference/horovod/torch/optimizer.py:100-186), plus
+``broadcast_parameters``/``broadcast_optimizer_state``
+(torch/functions.py). Here the collectives are horovod_tpu's eager plane
+(XLA over ICI/DCN); torch tensors bridge through host numpy — the analogue
+of the reference's ``*CudaOnCPU`` staging path (torch/mpi_ops_v2.cc:92+),
+appropriate because torch in this stack is CPU-resident while jax owns the
+TPU.
+
+Usage (identical shape to the reference's 5-line recipe)::
+
+    import horovod_tpu.torch as hvd
+    hvd.init()
+    optimizer = hvd.DistributedOptimizer(
+        torch.optim.SGD(model.parameters(), lr=0.1 * hvd.size()),
+        named_parameters=model.named_parameters())
+    hvd.broadcast_parameters(model.state_dict(), root_rank=0)
+"""
+
+from typing import Any, Dict, Iterator, Optional, Tuple
+
+import numpy as np
+
+from .. import basics as _basics
+from .. import collectives as _c
+from ..basics import (  # noqa: F401  (reference API parity re-exports)
+    init, shutdown, is_initialized, rank, size, local_rank, local_size,
+    cross_rank, cross_size,
+)
+from ..collectives import (  # noqa: F401
+    Average, Sum, Adasum, poll, synchronize as _synchronize_handle, join,
+)
+
+
+def _to_numpy(t) -> np.ndarray:
+    return t.detach().cpu().numpy()
+
+
+def _from_numpy(a, dtype):
+    """jax/numpy result -> torch tensor of the requested dtype (single
+    bridging point: jax arrays are non-writable, so copy)."""
+    import torch
+    return torch.from_numpy(np.array(a)).to(dtype)
+
+
+def allreduce(tensor, average=None, name: Optional[str] = None, op=None):
+    """Synchronous allreduce of a torch tensor; returns a torch tensor
+    (reference: torch/mpi_ops.py:158-200)."""
+    out = _c.allreduce(_to_numpy(tensor), average=average, name=name, op=op)
+    return _from_numpy(out, tensor.dtype)
+
+
+def allgather(tensor, name: Optional[str] = None):
+    out = _c.allgather(_to_numpy(tensor), name=name)
+    return _from_numpy(out, tensor.dtype)
+
+
+def broadcast(tensor, root_rank: int, name: Optional[str] = None):
+    out = _c.broadcast(_to_numpy(tensor), root_rank=root_rank, name=name)
+    return _from_numpy(out, tensor.dtype)
+
+
+def broadcast_parameters(params, root_rank: int = 0) -> None:
+    """In-place broadcast of a ``state_dict()`` or ``named_parameters``
+    iterable (reference: torch/functions.py broadcast_parameters)."""
+    import torch
+    if isinstance(params, dict):
+        items = sorted(params.items())
+    else:
+        items = sorted(dict(params).items())
+    for name, p in items:
+        if not isinstance(p, torch.Tensor):
+            continue
+        out = _c.broadcast(_to_numpy(p), root_rank=root_rank,
+                           name=f"bcast.param.{name}")
+        with torch.no_grad():
+            p.copy_(_from_numpy(out, p.dtype))
+
+
+def broadcast_optimizer_state(optimizer, root_rank: int = 0) -> None:
+    """Broadcast optimizer state tensors and scalar hyperparameters from
+    root (reference: torch/functions.py broadcast_optimizer_state).
+
+    The walk is driven by ROOT's state structure, broadcast first as a
+    spec: a freshly-constructed worker with empty state still issues the
+    identical collective sequence (contributing zeros that root's values
+    overwrite), matching the reference's design of rebuilding state from
+    root's pickled metadata."""
+    import torch
+    from ..functions import broadcast_object
+    local_state = optimizer.state_dict()
+    is_root = _basics.rank() == root_rank
+
+    spec: Dict[str, Any] = {"meta": None, "entries": []}
+    if is_root:
+        spec["meta"] = {k: v for k, v in local_state.items() if k != "state"}
+        for pid, pstate in sorted(local_state.get("state", {}).items()):
+            for key, val in sorted(pstate.items()):
+                if isinstance(val, torch.Tensor):
+                    spec["entries"].append(
+                        ("t", pid, key, tuple(val.shape), str(val.dtype)))
+                else:
+                    spec["entries"].append(("o", pid, key, val))
+    spec = broadcast_object(spec, root_rank=root_rank, name="bcast.opt.spec")
+
+    new_state: Dict[Any, Dict[str, Any]] = {}
+    for entry in spec["entries"]:
+        if entry[0] == "t":
+            _, pid, key, shape, dtype_s = entry
+            dtype = getattr(torch, dtype_s.split(".")[-1])
+            local = local_state.get("state", {}).get(pid, {}).get(key)
+            if isinstance(local, torch.Tensor) \
+                    and tuple(local.shape) == shape:
+                contrib = local.to(dtype)
+            else:
+                contrib = torch.zeros(shape, dtype=dtype)
+            out = _c.broadcast(_to_numpy(contrib), root_rank=root_rank,
+                               name=f"bcast.opt.{pid}.{key}")
+            new_state.setdefault(pid, {})[key] = _from_numpy(out, dtype)
+        else:
+            _, pid, key, val = entry
+            new_state.setdefault(pid, {})[key] = val
+    optimizer.load_state_dict({**spec["meta"], "state": new_state})
+
+
+class _DistributedOptimizer:
+    """Wraps a torch optimizer: backward hooks fire async allreduces per
+    parameter; ``step()`` synchronizes and applies (reference:
+    torch/optimizer.py:100-186)."""
+
+    def __init__(self, optimizer, named_parameters=None, op=_c.Average,
+                 backward_passes_per_step: int = 1):
+        self._opt = optimizer
+        self._op = op
+        self._bpps = backward_passes_per_step
+        self._pass_count: Dict[int, int] = {}
+        self._handles: Dict[Any, int] = {}
+        self._names: Dict[Any, str] = {}
+        all_params = [p for group in optimizer.param_groups
+                      for p in group["params"]]
+        if named_parameters is not None:
+            named = list(named_parameters)
+            # every optimizer parameter must be named, or its gradients
+            # would silently skip synchronization (reference:
+            # torch/optimizer.py:57-62 raises for unnamed parameters)
+            named_ids = {id(p) for _, p in named}
+            missing = [p for p in all_params if id(p) not in named_ids]
+            if missing:
+                raise ValueError(
+                    "named_parameters was specified, but one or more model "
+                    "parameters were not named. Python object ids: " +
+                    ", ".join(str(id(p)) for p in missing))
+        else:
+            named = [(f"param.{gi}.{pi}", p)
+                     for gi, group in enumerate(optimizer.param_groups)
+                     for pi, p in enumerate(group["params"])]
+        seen = set()
+        for name, p in named:
+            if name in seen:
+                raise ValueError(
+                    f"duplicate parameter name {name!r} (reference "
+                    f"semantics: optimizer.py name dedup)")
+            seen.add(name)
+            if p.requires_grad:
+                self._names[p] = name
+                p.register_post_accumulate_grad_hook(self._make_hook())
+
+    # hooks ------------------------------------------------------------------
+    def _make_hook(self):
+        def hook(p):
+            n = self._pass_count.get(id(p), 0) + 1
+            self._pass_count[id(p)] = n
+            if n >= self._bpps:
+                if p in self._handles:
+                    raise AssertionError(
+                        "Gradients were computed more than "
+                        "backward_passes_per_step times before call to "
+                        "step(). Increase backward_passes_per_step to "
+                        "accumulate gradients locally (reference: "
+                        "torch/optimizer.py:122-126).")
+                self._pass_count[id(p)] = 0
+                grad = _to_numpy(p.grad)
+                if self._bpps > 1:
+                    grad = grad / self._bpps
+                self._handles[p] = _c.allreduce_async(
+                    grad, op=self._op,
+                    name=f"grad.{self._names[p]}")
+        return hook
+
+    # torch optimizer protocol ----------------------------------------------
+    def synchronize(self):
+        import torch
+        for p, h in list(self._handles.items()):
+            out = _synchronize_handle(h)
+            with torch.no_grad():
+                p.grad.copy_(_from_numpy(out, p.grad.dtype))
+        self._handles.clear()
+
+    def step(self, closure=None):
+        self.synchronize()
+        return self._opt.step(closure)
+
+    def zero_grad(self, *a, **kw):
+        return self._opt.zero_grad(*a, **kw)
+
+    def state_dict(self, *a, **kw):
+        return self._opt.state_dict(*a, **kw)
+
+    def load_state_dict(self, *a, **kw):
+        return self._opt.load_state_dict(*a, **kw)
+
+    @property
+    def param_groups(self):
+        return self._opt.param_groups
+
+    @property
+    def state(self):
+        return self._opt.state
+
+    def __getattr__(self, item):
+        return getattr(self._opt, item)
+
+
+def DistributedOptimizer(optimizer, named_parameters=None, op=_c.Average,
+                         backward_passes_per_step: int = 1):
+    return _DistributedOptimizer(
+        optimizer, named_parameters=named_parameters, op=op,
+        backward_passes_per_step=backward_passes_per_step)

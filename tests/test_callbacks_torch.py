@@ -1,0 +1,188 @@
+"""Callback layer and torch-interop tests.
+
+Mirrors the reference coverage: warmup multiplier math against the Goyal
+formula (reference _keras/callbacks.py:169-190), metric averaging in place,
+broadcast-once semantics, and torch DistributedOptimizer steps matching a
+plain optimizer at world size 1 (reference test_torch.py gradient tests
+degrade to size-1 the same way)."""
+
+import numpy as np
+import pytest
+
+import horovod_tpu as hvd
+from horovod_tpu import callbacks as cbs
+
+
+class TestCallbacks:
+    def test_warmup_multiplier_formula(self, hvd_world):
+        run = cbs.TrainingRun(steps_per_epoch=10)
+        cb = cbs.LearningRateWarmupCallback(warmup_epochs=5, size=8)
+        cl = cbs.CallbackList([cb], run)
+        cl.on_train_begin()
+        # mid-warmup scales strictly increase toward 1
+        scales = []
+        for epoch in range(5):
+            cl.on_epoch_begin(epoch)
+            for batch in range(10):
+                cl.on_batch_begin(batch)
+            scales.append(run.lr_scale)
+        assert all(b > a for a, b in zip(scales, scales[1:]))
+        # reference formula at the last batch of the last warmup epoch:
+        # epoch' = 4 + 9/10 + 1/10 = 5 -> 1/8 * (5*7/5 + 1) = 1.0
+        np.testing.assert_allclose(scales[-1], 1.0, rtol=1e-6)
+        # first-step scale ~ 1/size * ((0 + 2/10)*7/5 + 1)
+        cl2 = cbs.CallbackList(
+            [cbs.LearningRateWarmupCallback(warmup_epochs=5, size=8)],
+            cbs.TrainingRun(steps_per_epoch=10))
+        cl2.on_epoch_begin(0)
+        cl2.on_batch_begin(1)
+        np.testing.assert_allclose(
+            cl2.run.lr_scale, 1 / 8 * ((0.1 + 0.1) * 7 / 5 + 1), rtol=1e-6)
+
+    def test_schedule_staircase_and_window(self, hvd_world):
+        run = cbs.TrainingRun(steps_per_epoch=4)
+        cb = cbs.LearningRateScheduleCallback(
+            multiplier=lambda e: 0.1 ** e, start_epoch=1, end_epoch=3)
+        cl = cbs.CallbackList([cb], run)
+        cl.on_epoch_begin(0)
+        cl.on_batch_begin(0)
+        assert run.lr_scale == 1.0            # before window
+        cl.on_epoch_begin(1)
+        cl.on_batch_begin(0)
+        np.testing.assert_allclose(run.lr_scale, 0.1)
+        cl.on_epoch_begin(3)
+        cl.on_batch_begin(0)
+        np.testing.assert_allclose(run.lr_scale, 0.1)  # frozen after window
+
+    def test_metric_average_and_broadcast_once(self, hvd_world):
+        run = cbs.TrainingRun(params={"w": np.ones(3, np.float32)})
+        bcast = cbs.BroadcastGlobalVariablesCallback(0)
+        cl = cbs.CallbackList([bcast, cbs.MetricAverageCallback()], run)
+        logs = {"loss": 2.5, "acc": np.float32(0.5), "name": "skipme"}
+        cl.on_batch_end(0, logs)
+        assert bcast._done
+        cl.on_epoch_end(0, logs)
+        assert logs["loss"] == 2.5 and logs["acc"] == 0.5  # size-1 identity
+        assert logs["name"] == "skipme"                    # non-scalar kept
+
+    def test_scaled_schedule(self, hvd_world):
+        run = cbs.TrainingRun()
+        sched = cbs.scaled_schedule(lambda step: 0.5, run)
+        assert sched(0) == 0.5
+        run.lr_scale = 0.2
+        np.testing.assert_allclose(sched(0), 0.1)
+
+
+class TestTorchInterop:
+    def test_allreduce_broadcast_roundtrip(self, hvd_world):
+        import torch
+        import horovod_tpu.torch as hvd_t
+        t = torch.arange(6, dtype=torch.float32)
+        out = hvd_t.allreduce(t, name="t.ar")
+        assert torch.allclose(out, t)
+        out = hvd_t.broadcast(t, root_rank=0, name="t.bc")
+        assert torch.allclose(out, t)
+        g = hvd_t.allgather(t.reshape(2, 3), name="t.ag")
+        assert g.shape == (2, 3)
+
+    def test_distributed_optimizer_matches_plain(self, hvd_world):
+        import torch
+        import horovod_tpu.torch as hvd_t
+        torch.manual_seed(0)
+        m1 = torch.nn.Linear(4, 2)
+        m2 = torch.nn.Linear(4, 2)
+        m2.load_state_dict(m1.state_dict())
+        o1 = torch.optim.SGD(m1.parameters(), lr=0.1)
+        o2 = hvd_t.DistributedOptimizer(
+            torch.optim.SGD(m2.parameters(), lr=0.1),
+            named_parameters=m2.named_parameters())
+        x = torch.randn(8, 4)
+        for _ in range(3):
+            o1.zero_grad(); o2.zero_grad()
+            loss1 = m1(x).pow(2).sum(); loss1.backward(); o1.step()
+            loss2 = m2(x).pow(2).sum(); loss2.backward(); o2.step()
+        for p1, p2 in zip(m1.parameters(), m2.parameters()):
+            assert torch.allclose(p1, p2, atol=1e-6), (p1, p2)
+
+    def test_broadcast_parameters_state_dict(self, hvd_world):
+        import torch
+        import horovod_tpu.torch as hvd_t
+        m = torch.nn.Linear(3, 3)
+        want = {k: v.clone() for k, v in m.state_dict().items()}
+        hvd_t.broadcast_parameters(m.state_dict(), root_rank=0)
+        for k, v in m.state_dict().items():
+            assert torch.allclose(v, want[k])
+
+    def test_broadcast_optimizer_state(self, hvd_world):
+        import torch
+        import horovod_tpu.torch as hvd_t
+        m = torch.nn.Linear(3, 3)
+        opt = torch.optim.Adam(m.parameters(), lr=1e-3)
+        m(torch.randn(2, 3)).sum().backward()
+        opt.step()
+        hvd_t.broadcast_optimizer_state(opt, root_rank=0)  # size-1 no-op
+        assert opt.state_dict()["state"]
+
+    def test_backward_passes_per_step(self, hvd_world):
+        import torch
+        import horovod_tpu.torch as hvd_t
+        m = torch.nn.Linear(2, 1, bias=False)
+        opt = hvd_t.DistributedOptimizer(
+            torch.optim.SGD(m.parameters(), lr=1.0),
+            named_parameters=m.named_parameters(),
+            backward_passes_per_step=2)
+        x = torch.ones(1, 2)
+        # two backwards accumulate; hook fires on the second
+        m(x).sum().backward()
+        assert not opt._handles
+        m(x).sum().backward()
+        assert opt._handles
+        opt.step()
+
+
+class TestElasticCallbacks:
+    def test_commit_and_state_tracking(self, hvd_world):
+        from horovod_tpu import elastic
+        commits = []
+
+        class S(elastic.ObjectState):
+            def commit(self):
+                commits.append(1)
+                super().save()
+
+        s = S(epoch=0, batch=0)
+        run = cbs.TrainingRun()
+        cl = cbs.CallbackList([
+            elastic.CommitStateCallback(s, batches_per_commit=2),
+            elastic.UpdateBatchStateCallback(s),
+            elastic.UpdateEpochStateCallback(s)], run)
+        cl.on_epoch_begin(0)
+        for b in range(5):
+            cl.on_batch_end(b)
+        assert len(commits) == 2           # batches 1 and 3
+        assert s.batch == 4
+        cl.on_epoch_end(0)
+        assert len(commits) == 3 and s.batch == 0 and s.epoch == 0
+
+    def test_unnamed_parameter_raises(self, hvd_world):
+        import torch
+        import horovod_tpu.torch as hvd_t
+        m = torch.nn.Linear(2, 2)
+        extra = torch.nn.Parameter(torch.zeros(3))
+        opt = torch.optim.SGD(list(m.parameters()) + [extra], lr=0.1)
+        with pytest.raises(ValueError, match="not named"):
+            hvd_t.DistributedOptimizer(
+                opt, named_parameters=m.named_parameters())
+
+    def test_excess_backward_raises(self, hvd_world):
+        import torch
+        import horovod_tpu.torch as hvd_t
+        m = torch.nn.Linear(2, 1, bias=False)
+        opt = hvd_t.DistributedOptimizer(
+            torch.optim.SGD(m.parameters(), lr=1.0),
+            named_parameters=m.named_parameters())
+        x = torch.ones(1, 2)
+        m(x).sum().backward()
+        with pytest.raises(AssertionError, match="backward_passes_per_step"):
+            m(x).sum().backward()
+        opt.synchronize()
