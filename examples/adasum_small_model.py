@@ -1,0 +1,62 @@
+"""Adasum reduction on a small model.
+
+Counterpart of the reference's adasum_small_model.py: train the same tiny
+model with op=Average and op=Adasum and print the resulting parameter
+trajectories. Adasum's scale-invariant combining rule
+(a' = (1 - dot/2||a||^2) a + (1 - dot/2||b||^2) b, reference
+ops/adasum/adasum.h:385-396) needs no LR rescaling as world size grows.
+
+Run: python adasum_small_model.py
+"""
+
+import os as _os
+import sys as _sys
+_sys.path.insert(0, _os.path.join(_os.path.dirname(_os.path.abspath(__file__)), ".."))
+# honor JAX_PLATFORMS even where a platform plugin tries to take priority
+if _os.environ.get("JAX_PLATFORMS"):
+    import jax as _jax
+    _jax.config.update("jax_platforms", _os.environ["JAX_PLATFORMS"])
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+import horovod_tpu as hvd
+from horovod_tpu.models import MLP
+
+
+def train(op, steps=20, lr=0.05):
+    model = MLP(features=(16, 1))
+    rng = np.random.RandomState(hvd.rank())
+    x = jnp.asarray(rng.randn(64, 8), jnp.float32)
+    y = jnp.sum(x[:, :2], axis=1, keepdims=True)
+    params = model.init(jax.random.PRNGKey(0), x)
+    opt = hvd.DistributedOptimizer(optax.sgd(lr), op=op)
+    state = opt.init(params)
+
+    @jax.jit
+    def grads_fn(p):
+        return jax.grad(
+            lambda p: jnp.mean((model.apply(p, x) - y) ** 2))(p)
+
+    losses = []
+    for _ in range(steps):
+        g = grads_fn(params)
+        updates, state = opt.update(g, state, params)
+        params = optax.apply_updates(params, updates)
+        losses.append(float(jnp.mean((model.apply(params, x) - y) ** 2)))
+    return losses
+
+
+def main():
+    hvd.init()
+    for op, label in [(hvd.Average, "average"), (hvd.Adasum, "adasum")]:
+        losses = train(op)
+        if hvd.rank() == 0:
+            print(f"{label:8s} loss: {losses[0]:.4f} -> {losses[-1]:.4f}")
+    hvd.shutdown()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,104 @@
+"""Elastic data-parallel training.
+
+Counterpart of the reference's examples/elastic/pytorch_mnist_elastic.py:
+training state (params, optimizer state, epoch/batch counters) lives in an
+elastic ``JaxState``; ``@hvd.elastic.run`` wraps the training function in
+the sync -> train -> on-failure restore/reset retry loop
+(reference common/elastic.py:147-168). Commit callbacks bound the work lost
+to a worker failure.
+
+Launch elastically:
+  horovodrun-tpu -np 2 --min-np 1 --max-np 4 \
+      --host-discovery-script ./discover_hosts.sh python jax_mnist_elastic.py
+Also runs standalone (world of one, no failures).
+"""
+
+import argparse
+
+import os as _os
+import sys as _sys
+# allow running from a source checkout without installation
+_sys.path.insert(0, _os.path.join(_os.path.dirname(_os.path.abspath(__file__)), "../.."))
+# honor JAX_PLATFORMS even where a platform plugin tries to take priority
+if _os.environ.get("JAX_PLATFORMS"):
+    import jax as _jax
+    _jax.config.update("jax_platforms", _os.environ["JAX_PLATFORMS"])
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+import horovod_tpu as hvd
+from horovod_tpu import callbacks as cbs
+from horovod_tpu.models import MLP
+
+
+def synthetic_mnist(n=2048, seed=0):
+    rng = np.random.RandomState(seed)
+    y = rng.randint(0, 10, size=n)
+    centers = rng.randn(10, 784).astype(np.float32)
+    x = centers[y] + 0.3 * rng.randn(n, 784).astype(np.float32)
+    return x, y
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--epochs", type=int, default=3)
+    p.add_argument("--batch-size", type=int, default=64)
+    p.add_argument("--batches-per-commit", type=int, default=10)
+    args = p.parse_args()
+
+    hvd.init()
+    model = MLP(features=(128, 10))
+    x_all, y_all = synthetic_mnist()
+    params = model.init(jax.random.PRNGKey(0), x_all[:1])
+    opt = hvd.DistributedOptimizer(optax.adam(1e-3 * hvd.size()))
+
+    state = hvd.elastic.JaxState(
+        params=params, opt_state=opt.init(params), epoch=0, batch=0)
+
+    @jax.jit
+    def loss_and_grads(params, x, y):
+        def loss_fn(p):
+            logits = model.apply(p, x)
+            return optax.softmax_cross_entropy_with_integer_labels(
+                logits, y).mean()
+        return jax.value_and_grad(loss_fn)(params)
+
+    @hvd.elastic.run
+    def train(state):
+        # re-shard data for the (possibly resized) world
+        x = x_all[hvd.rank()::hvd.size()]
+        y = y_all[hvd.rank()::hvd.size()]
+        steps = len(x) // args.batch_size
+        run = cbs.TrainingRun(params=state.params, steps_per_epoch=steps)
+        cl = cbs.CallbackList([
+            hvd.elastic.CommitStateCallback(
+                state, batches_per_commit=args.batches_per_commit),
+            hvd.elastic.UpdateBatchStateCallback(state),
+            hvd.elastic.UpdateEpochStateCallback(state),
+        ], run)
+        # resume from the committed epoch/batch
+        for epoch in range(state.epoch, args.epochs):
+            cl.on_epoch_begin(epoch)
+            for batch in range(state.batch, steps):
+                lo = batch * args.batch_size
+                loss, grads = loss_and_grads(
+                    state.params, x[lo:lo + args.batch_size],
+                    y[lo:lo + args.batch_size])
+                updates, state.opt_state = opt.update(
+                    grads, state.opt_state, state.params)
+                state.params = optax.apply_updates(state.params, updates)
+                cl.on_batch_end(batch, {"loss": float(loss)})
+            cl.on_epoch_end(epoch)
+            if hvd.rank() == 0:
+                print(f"epoch {epoch}: loss={float(loss):.4f} "
+                      f"(world size {hvd.size()})")
+
+    train(state)
+    hvd.shutdown()
+
+
+if __name__ == "__main__":
+    main()

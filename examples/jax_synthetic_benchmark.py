@@ -1,0 +1,54 @@
+"""ResNet-50 synthetic throughput benchmark.
+
+Counterpart of the reference's tensorflow2_synthetic_benchmark.py /
+pytorch_synthetic_benchmark.py (defaults mirrored: ResNet-50, batch 32 per
+chip, 10 warmup batches, 10 iterations x 10 batches). Prints per-chip and
+total images/sec.
+
+Run: python jax_synthetic_benchmark.py [--batch-size 32] [--num-iters 10]
+"""
+
+import argparse
+
+import os as _os
+import sys as _sys
+# allow running from a source checkout without installation
+_sys.path.insert(0, _os.path.join(_os.path.dirname(_os.path.abspath(__file__)), ".."))
+# honor JAX_PLATFORMS even where a platform plugin tries to take priority
+if _os.environ.get("JAX_PLATFORMS"):
+    import jax as _jax
+    _jax.config.update("jax_platforms", _os.environ["JAX_PLATFORMS"])
+
+import horovod_tpu as hvd
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--batch-size", type=int, default=32,
+                   help="batch size per chip")
+    p.add_argument("--num-warmup-batches", type=int, default=10)
+    p.add_argument("--num-batches-per-iter", type=int, default=10)
+    p.add_argument("--num-iters", type=int, default=10)
+    p.add_argument("--fp16-allreduce", action="store_true",
+                   help="compress gradients to fp16 (reference knob; the "
+                        "compiled path reduces in bf16 natively)")
+    args = p.parse_args()
+
+    hvd.init()
+    from horovod_tpu.benchmark import synthetic_resnet50_benchmark
+    r = synthetic_resnet50_benchmark(
+        batch_per_chip=args.batch_size,
+        num_warmup_batches=args.num_warmup_batches,
+        num_batches_per_iter=args.num_batches_per_iter,
+        num_iters=args.num_iters)
+    if hvd.rank() == 0:
+        print(f"Model: resnet50, batch size {args.batch_size}/chip, "
+              f"{r.num_chips} chips")
+        print(f"Img/sec per chip: {r.images_per_sec_per_chip:.1f}")
+        print(f"Total img/sec on {r.num_chips} chip(s): "
+              f"{r.images_per_sec_total:.1f}")
+    hvd.shutdown()
+
+
+if __name__ == "__main__":
+    main()

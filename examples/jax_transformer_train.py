@@ -1,0 +1,78 @@
+"""Compiled SPMD transformer training over a multi-axis mesh.
+
+Beyond the reference's scope (it is data-parallel only, SURVEY.md §2.3):
+one jitted training step sharded over a dp x fsdp x sp x tp mesh, with
+ring attention carrying sequence parallelism over 'sp' (the Pallas flash
+kernel on TPU) and tensor parallelism over 'tp'. This is the shape of the
+flagship path the driver dry-runs via __graft_entry__.dryrun_multichip.
+
+Run (single host, virtual 8-device mesh):
+  XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
+  python jax_transformer_train.py
+"""
+
+import argparse
+
+import os as _os
+import sys as _sys
+# allow running from a source checkout without installation
+_sys.path.insert(0, _os.path.join(_os.path.dirname(_os.path.abspath(__file__)), ".."))
+# honor JAX_PLATFORMS even where a platform plugin tries to take priority
+if _os.environ.get("JAX_PLATFORMS"):
+    import jax as _jax
+    _jax.config.update("jax_platforms", _os.environ["JAX_PLATFORMS"])
+
+import jax
+import numpy as np
+
+import horovod_tpu as hvd
+from horovod_tpu.models import TransformerConfig
+from horovod_tpu.parallel import MeshConfig, make_training_mesh
+from horovod_tpu.parallel.train import make_transformer_train_step
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--steps", type=int, default=5)
+    p.add_argument("--d-model", type=int, default=128)
+    p.add_argument("--layers", type=int, default=2)
+    p.add_argument("--seq-len", type=int, default=256)
+    p.add_argument("--batch", type=int, default=8)
+    args = p.parse_args()
+
+    hvd.init()
+    n = jax.device_count()
+    if n >= 8:
+        mc = MeshConfig(dp=-1, sp=2, tp=2)
+    elif n >= 4:
+        mc = MeshConfig(dp=-1, sp=2)
+    else:
+        mc = MeshConfig(dp=-1)
+    mesh = make_training_mesh(mc, jax.devices())
+    if hvd.rank() == 0:
+        print("mesh:", dict(zip(mesh.axis_names, mesh.devices.shape)))
+
+    cfg = TransformerConfig(
+        vocab_size=512, num_layers=args.layers, d_model=args.d_model,
+        num_heads=8, head_dim=args.d_model // 8, max_seq_len=args.seq_len)
+    bundle = make_transformer_train_step(cfg, mesh)
+    params, opt_state = bundle.params, bundle.opt_state
+
+    rng = np.random.RandomState(0)
+    for i in range(args.steps):
+        tokens = jax.device_put(
+            rng.randint(0, cfg.vocab_size,
+                        size=(args.batch, args.seq_len)).astype(np.int32),
+            bundle.batch_sharding)
+        targets = jax.device_put(
+            np.roll(np.asarray(tokens), -1, axis=1).astype(np.int32),
+            bundle.batch_sharding)
+        params, opt_state, loss = bundle.step(params, opt_state,
+                                              tokens, targets)
+        if hvd.rank() == 0:
+            print(f"step {i}: loss={float(loss):.4f}")
+    hvd.shutdown()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,85 @@
+"""PyTorch model trained through the TPU collective plane.
+
+Counterpart of the reference's pytorch_mnist.py: the model and optimizer
+are plain torch; gradients synchronize through horovod_tpu's eager
+collectives via ``horovod_tpu.torch.DistributedOptimizer`` (grad-hook
+architecture of the reference, torch/optimizer.py:100-186).
+
+Run: python torch_mnist.py [--epochs 2]
+"""
+
+import argparse
+
+import os as _os
+import sys as _sys
+# allow running from a source checkout without installation
+_sys.path.insert(0, _os.path.join(_os.path.dirname(_os.path.abspath(__file__)), ".."))
+# honor JAX_PLATFORMS even where a platform plugin tries to take priority
+if _os.environ.get("JAX_PLATFORMS"):
+    import jax as _jax
+    _jax.config.update("jax_platforms", _os.environ["JAX_PLATFORMS"])
+
+import numpy as np
+import torch
+import torch.nn.functional as F
+
+import horovod_tpu.torch as hvd
+
+
+class Net(torch.nn.Module):
+    def __init__(self):
+        super().__init__()
+        self.fc1 = torch.nn.Linear(784, 128)
+        self.fc2 = torch.nn.Linear(128, 10)
+
+    def forward(self, x):
+        return self.fc2(F.relu(self.fc1(x)))
+
+
+def synthetic_mnist(n=2048, seed=0):
+    rng = np.random.RandomState(seed)
+    y = rng.randint(0, 10, size=n)
+    centers = rng.randn(10, 784).astype(np.float32)
+    x = centers[y] + 0.3 * rng.randn(n, 784).astype(np.float32)
+    return torch.from_numpy(x), torch.from_numpy(y)
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--epochs", type=int, default=2)
+    p.add_argument("--batch-size", type=int, default=64)
+    p.add_argument("--lr", type=float, default=0.01)
+    args = p.parse_args()
+
+    hvd.init()
+    torch.manual_seed(1234)
+
+    model = Net()
+    x_all, y_all = synthetic_mnist()
+    x_all = x_all[hvd.rank()::hvd.size()]
+    y_all = y_all[hvd.rank()::hvd.size()]
+
+    # reference recipe: scale LR, wrap optimizer, broadcast state
+    optimizer = hvd.DistributedOptimizer(
+        torch.optim.SGD(model.parameters(), lr=args.lr * hvd.size()),
+        named_parameters=model.named_parameters())
+    hvd.broadcast_parameters(model.state_dict(), root_rank=0)
+    hvd.broadcast_optimizer_state(optimizer, root_rank=0)
+
+    steps = len(x_all) // args.batch_size
+    for epoch in range(args.epochs):
+        for b in range(steps):
+            lo = b * args.batch_size
+            x, y = x_all[lo:lo + args.batch_size], y_all[lo:lo + args.batch_size]
+            optimizer.zero_grad()
+            loss = F.cross_entropy(model(x), y)
+            loss.backward()
+            optimizer.step()
+        avg_loss = hvd.allreduce(loss.detach(), name="loss")
+        if hvd.rank() == 0:
+            print(f"epoch {epoch}: loss={float(avg_loss):.4f}")
+    hvd.shutdown()
+
+
+if __name__ == "__main__":
+    main()

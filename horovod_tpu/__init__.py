@@ -66,7 +66,7 @@ def __getattr__(name):
                 "allreduce_sparse_as_dense", "sparse_to_dense"):
         from . import sparse
         return getattr(sparse, name)
-    if name in ("callbacks", "torch"):
+    if name in ("callbacks", "torch", "data", "checkpoint"):
         # importlib, not `from . import x`: the fromlist lookup re-enters
         # this __getattr__ before sys.modules is populated (see `elastic`)
         import importlib

@@ -24,10 +24,12 @@ Typical loop::
         cbs.on_epoch_begin(epoch)
         for batch in range(spe):
             cbs.on_batch_begin(batch)
-            params, opt_state, logs = step(params, opt_state,
+            params, opt_state, logs = step(run.params, opt_state,
                                            lr_scale=run.lr_scale)
             run.params = params
             cbs.on_batch_end(batch, logs)
+            # NOTE: always train on run.params (re-read after the hooks):
+            # BroadcastGlobalVariablesCallback rewrites it at batch 0
         cbs.on_epoch_end(epoch, logs)
 """
 

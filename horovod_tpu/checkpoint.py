@@ -1,0 +1,107 @@
+"""Checkpoint / resume.
+
+The reference has no checkpoint subsystem of its own (SURVEY.md §5): its
+pattern is (a) rank-0-only framework checkpoints in examples
+(/root/reference/examples/pytorch_mnist.py), (b) elastic in-memory State
+commit/restore (common/elastic.py:60-101), (c) broadcast_parameters /
+broadcast_object to seed restarted workers. The TPU build provides a real
+one, because on TPU pods checkpointing is a first-class scaling concern:
+
+* :func:`save` / :func:`restore` — orbax-backed pytree checkpointing.
+  Process 0 coordinates in the single-controller model (the reference's
+  rank-0-only convention); with a multi-host jax runtime orbax writes
+  sharded arrays from every host.
+* :func:`latest_step` — resume discovery.
+* :class:`CheckpointCallback` — periodic saves from the callback loop.
+
+Restored arrays can be re-staged onto a target sharding (mesh topology may
+differ across restarts — the elastic resume case).
+"""
+
+import os
+import re
+from typing import Any, Optional
+
+from .callbacks import Callback
+
+# completed checkpoints only: orbax writes to
+# "step_<n>.orbax-checkpoint-tmp-<ts>" before renaming, and a crashed save
+# must not poison discovery
+_STEP_RE = re.compile(r"^step_(\d+)$")
+
+
+def _checkpointer():
+    import orbax.checkpoint as ocp
+    return ocp.PyTreeCheckpointer()
+
+
+def _step_dir(directory: str, step: int) -> str:
+    return os.path.join(directory, f"step_{step:010d}")
+
+
+def save(directory: str, step: int, tree: Any, force: bool = False) -> str:
+    """Save ``tree`` (params / train state pytree) for ``step``. Only
+    process 0 writes in the one-process-per-host eager model unless the
+    jax runtime is multi-host-initialized (then orbax coordinates all
+    hosts). Returns the checkpoint path."""
+    from . import basics
+    path = _step_dir(directory, step)
+    multihost = False
+    try:
+        import jax
+        multihost = jax.process_count() > 1
+    except Exception:
+        pass
+    if multihost or not basics.is_initialized() or basics.rank() == 0:
+        _checkpointer().save(path, tree, force=force)
+    if not multihost and basics.is_initialized() and basics.size() > 1:
+        # non-root processes must not observe the path before rank 0's
+        # write completes (reference convention: rank-0 checkpoint + implicit
+        # barrier before the next collective)
+        from .collectives import barrier
+        barrier()
+    return path
+
+
+def restore(directory: str, step: Optional[int] = None, target: Any = None,
+            sharding=None) -> Any:
+    """Restore the pytree saved at ``step`` (default: latest). ``target``
+    (optional) provides structure/dtypes; ``sharding`` re-stages leaves
+    onto a mesh after restore (elastic resume onto a resized mesh)."""
+    if step is None:
+        step = latest_step(directory)
+        if step is None:
+            raise FileNotFoundError(
+                f"no checkpoints under {directory!r}")
+    tree = _checkpointer().restore(_step_dir(directory, step), item=target)
+    if sharding is not None:
+        import jax
+        tree = jax.device_put(tree, sharding)
+    return tree
+
+
+def latest_step(directory: str) -> Optional[int]:
+    try:
+        steps = [int(m.group(1)) for name in os.listdir(directory)
+                 if (m := _STEP_RE.match(name))]
+    except FileNotFoundError:
+        return None
+    return max(steps) if steps else None
+
+
+class CheckpointCallback(Callback):
+    """Save ``run.params`` every ``epochs_per_save`` epochs (rank-0
+    convention of the reference examples: examples/pytorch_mnist.py guards
+    checkpointing with hvd.rank() == 0)."""
+
+    def __init__(self, directory: str, epochs_per_save: int = 1,
+                 force: bool = True):
+        self.directory = directory
+        self.epochs_per_save = epochs_per_save
+        # force=True: an elastic resume re-saves epochs that already exist
+        # on disk; refusing to overwrite would kill the resumed run
+        self.force = force
+
+    def on_epoch_end(self, epoch, logs=None):
+        if (epoch + 1) % self.epochs_per_save == 0:
+            save(self.directory, epoch, self.run.params, force=self.force)

@@ -1,0 +1,166 @@
+"""Data feeding: sharding, host->device prefetch, double buffering.
+
+The reference's data path is framework data loaders plus
+``DistributedSampler``-style sharding in examples
+(/root/reference/examples/pytorch_mnist.py: DistributedSampler(num_replicas
+= hvd.size(), rank = hvd.rank())) and Petastorm readers in the Spark layer
+(spark/keras/estimator.py). The TPU-native bottleneck is different: the
+chips stall whenever the host feed falls behind, so the load-bearing
+component here is an **async host->device prefetcher** — batches are pushed
+to device (with the training mesh's batch sharding) a configurable depth
+ahead of consumption, overlapping host work with device steps the same way
+the reference's finalizer-thread pipelining overlaps collectives with
+compute (gpu_operations.cc:60-87).
+
+* :func:`shard_dataset` — deterministic per-process sharding (the
+  DistributedSampler analogue).
+* :class:`PrefetchIterator` / :func:`prefetch_to_device` — background
+  thread stages the next ``buffer_size`` batches via ``jax.device_put``.
+* :func:`batches` — simple epoch iterator over array data with optional
+  shuffling, drop-remainder semantics (SPMD needs static shapes).
+"""
+
+import queue
+import threading
+from typing import Any, Callable, Iterable, Iterator, Optional
+
+import numpy as np
+
+
+def shard_dataset(arrays, rank: Optional[int] = None,
+                  size: Optional[int] = None):
+    """Slice each array to this process's shard: ``a[rank::size]``
+    (reference examples' DistributedSampler semantics — disjoint,
+    near-equal shards)."""
+    from . import basics
+    if rank is None:
+        rank = basics.rank() if basics.is_initialized() else 0
+    if size is None:
+        size = basics.size() if basics.is_initialized() else 1
+    if isinstance(arrays, (list, tuple)):
+        return type(arrays)(a[rank::size] for a in arrays)
+    return arrays[rank::size]
+
+
+def batches(arrays, batch_size: int, shuffle: bool = True,
+            seed: int = 0, drop_remainder: bool = True) -> Iterator:
+    """Yield minibatch tuples from equal-length arrays. The remainder is
+    dropped by default: compiled SPMD steps need static shapes (the
+    reference instead pads/Joins on uneven data; Join remains available for
+    the eager plane)."""
+    single = not isinstance(arrays, (list, tuple))
+    arrs = [arrays] if single else list(arrays)
+    n = len(arrs[0])
+    if any(len(a) != n for a in arrs):
+        raise ValueError("all arrays must share the first dimension")
+    idx = np.arange(n)
+    if shuffle:
+        np.random.RandomState(seed).shuffle(idx)
+    stop = (n // batch_size) * batch_size if drop_remainder else n
+    for lo in range(0, stop, batch_size):
+        sel = idx[lo:lo + batch_size]
+        out = tuple(a[sel] for a in arrs)
+        yield out[0] if single else out
+
+
+class PrefetchIterator:
+    """Wraps an iterator of (pytrees of) host batches; a daemon thread
+    stages up to ``buffer_size`` batches onto device ahead of the consumer.
+
+    ``sharding`` (optional) is applied by ``jax.device_put`` — pass the
+    training step's batch NamedSharding so staged arrays land pre-sharded
+    over the mesh and the compiled step does zero re-layout.
+    """
+
+    _END = object()
+
+    def __init__(self, it: Iterable, buffer_size: int = 2, sharding=None,
+                 device_put: bool = True):
+        self._src = iter(it)
+        self._sharding = sharding
+        self._device_put = device_put
+        self._q: "queue.Queue" = queue.Queue(maxsize=max(1, buffer_size))
+        self._err: Optional[BaseException] = None
+        self._finished = False
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._worker, name="hvd_tpu_prefetch", daemon=True)
+        self._thread.start()
+
+    def _stage(self, batch):
+        if not self._device_put:
+            return batch
+        import jax
+        if self._sharding is not None:
+            return jax.device_put(batch, self._sharding)
+        return jax.device_put(batch)
+
+    def _put(self, item) -> bool:
+        """Bounded put that aborts on close(); returns False when closed."""
+        while not self._stop.is_set():
+            try:
+                self._q.put(item, timeout=0.1)
+                return True
+            except queue.Full:
+                continue
+        return False
+
+    def _worker(self):
+        try:
+            for batch in self._src:
+                if not self._put(self._stage(batch)):
+                    return  # closed: drop staged batches, free the thread
+        except BaseException as e:  # surfaced on the consumer thread
+            self._err = e
+        finally:
+            self._put(self._END)
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        if self._finished:
+            # keep raising after exhaustion/error instead of blocking on a
+            # queue the worker has already left
+            if self._err is not None:
+                raise self._err
+            raise StopIteration
+        item = self._q.get()
+        if item is self._END:
+            self._finished = True
+            if self._err is not None:
+                raise self._err
+            raise StopIteration
+        return item
+
+    def close(self):
+        """Stop the worker and drop buffered batches. Call when abandoning
+        the iterator mid-epoch (elastic reset, step budget) — otherwise the
+        worker thread and up to buffer_size device-resident batches stay
+        pinned for the process lifetime."""
+        self._stop.set()
+        self._finished = True
+        while True:
+            try:
+                self._q.get_nowait()
+            except queue.Empty:
+                break
+        self._thread.join(timeout=5)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    def __del__(self):
+        try:
+            self._stop.set()
+        except Exception:
+            pass
+
+
+def prefetch_to_device(it: Iterable, buffer_size: int = 2,
+                       sharding=None) -> PrefetchIterator:
+    """Convenience constructor; see :class:`PrefetchIterator`."""
+    return PrefetchIterator(it, buffer_size=buffer_size, sharding=sharding)

@@ -1,0 +1,165 @@
+"""Data pipeline and checkpoint subsystem tests."""
+
+import os
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from horovod_tpu import checkpoint as ckpt
+from horovod_tpu import data as hdata
+
+
+class TestData:
+    def test_shard_dataset_disjoint_cover(self, hvd_world):
+        x = np.arange(10)
+        shards = [hdata.shard_dataset(x, rank=r, size=3) for r in range(3)]
+        assert sorted(np.concatenate(shards).tolist()) == list(range(10))
+        assert all(abs(len(a) - len(b)) <= 1
+                   for a in shards for b in shards)
+
+    def test_batches_shapes_and_determinism(self):
+        x = np.arange(23)
+        y = np.arange(23) * 2
+        b1 = list(hdata.batches((x, y), 5, seed=7))
+        b2 = list(hdata.batches((x, y), 5, seed=7))
+        assert len(b1) == 4  # drop remainder
+        for (xa, ya), (xb, yb) in zip(b1, b2):
+            np.testing.assert_array_equal(xa, xb)
+            np.testing.assert_array_equal(ya, 2 * xa)  # rows stay aligned
+
+    def test_prefetch_yields_device_arrays_in_order(self):
+        src = [{"x": np.full((2, 2), i, np.float32)} for i in range(6)]
+        out = list(hdata.prefetch_to_device(iter(src), buffer_size=3))
+        assert len(out) == 6
+        for i, b in enumerate(out):
+            assert isinstance(b["x"], jax.Array)
+            np.testing.assert_array_equal(np.asarray(b["x"]), src[i]["x"])
+
+    def test_prefetch_overlaps_producer(self):
+        """The background thread must run ahead of the consumer."""
+        produced = []
+
+        def slow_src():
+            for i in range(4):
+                produced.append(i)
+                yield np.zeros(1, np.float32)
+
+        it = hdata.PrefetchIterator(slow_src(), buffer_size=4,
+                                    device_put=False)
+        deadline = time.monotonic() + 5
+        while len(produced) < 4 and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert len(produced) == 4  # fully prefetched before any consume
+        assert len(list(it)) == 4
+
+    def test_prefetch_propagates_errors(self):
+        def bad():
+            yield np.zeros(1)
+            raise RuntimeError("source exploded")
+
+        it = hdata.prefetch_to_device(bad(), buffer_size=2)
+        next(it)
+        with pytest.raises(RuntimeError, match="source exploded"):
+            next(it)
+
+    def test_prefetch_with_sharding(self, mesh8):
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        sharding = NamedSharding(mesh8, P("world"))
+        src = [np.arange(16, dtype=np.float32).reshape(16, 1)] * 2
+        out = list(hdata.prefetch_to_device(iter(src), sharding=sharding))
+        assert out[0].sharding == sharding
+
+
+class TestCheckpoint:
+    def test_save_restore_roundtrip(self, tmp_path, hvd_world):
+        tree = {"w": jnp.arange(6, dtype=jnp.float32).reshape(2, 3),
+                "b": jnp.ones(3, jnp.float32)}
+        ckpt.save(str(tmp_path), 3, tree)
+        ckpt.save(str(tmp_path), 7, jax.tree_util.tree_map(lambda a: a * 2,
+                                                           tree))
+        assert ckpt.latest_step(str(tmp_path)) == 7
+        out = ckpt.restore(str(tmp_path))  # latest
+        np.testing.assert_allclose(np.asarray(out["w"]),
+                                   2 * np.asarray(tree["w"]))
+        out3 = ckpt.restore(str(tmp_path), step=3)
+        np.testing.assert_allclose(np.asarray(out3["b"]), 1.0)
+
+    def test_restore_missing_raises(self, tmp_path):
+        with pytest.raises(FileNotFoundError):
+            ckpt.restore(str(tmp_path / "nope"))
+
+    def test_checkpoint_callback(self, tmp_path, hvd_world):
+        from horovod_tpu import callbacks as cbs
+        run = cbs.TrainingRun(params={"w": jnp.zeros(2)})
+        cl = cbs.CallbackList(
+            [ckpt.CheckpointCallback(str(tmp_path), epochs_per_save=2)], run)
+        for epoch in range(4):
+            cl.on_epoch_end(epoch)
+        assert ckpt.latest_step(str(tmp_path)) == 3
+        assert ckpt.restore(str(tmp_path), step=1) is not None
+
+    def test_restore_with_sharding(self, tmp_path, hvd_world, mesh8):
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        tree = {"x": jnp.arange(16, dtype=jnp.float32)}
+        ckpt.save(str(tmp_path), 0, tree)
+        sharding = {"x": NamedSharding(mesh8, P("world"))}
+        out = ckpt.restore(str(tmp_path), step=0, sharding=sharding)
+        assert out["x"].sharding == sharding["x"]
+
+    def test_latest_step_ignores_orbax_tmp_dirs(self, tmp_path):
+        os.makedirs(tmp_path / "step_0000000007")
+        os.makedirs(tmp_path / "step_0000000009.orbax-checkpoint-tmp-12345")
+        assert ckpt.latest_step(str(tmp_path)) == 7
+
+    def test_checkpoint_callback_resave_same_epoch(self, tmp_path, hvd_world):
+        from horovod_tpu import callbacks as cbs
+        run = cbs.TrainingRun(params={"w": jnp.zeros(2)})
+        cb = ckpt.CheckpointCallback(str(tmp_path), epochs_per_save=1)
+        cl = cbs.CallbackList([cb], run)
+        cl.on_epoch_end(0)
+        cl.on_epoch_end(0)  # elastic resume re-saves epoch 0: must not raise
+
+
+class TestPrefetchLifecycle:
+    def test_next_after_exhaustion_raises(self):
+        it = hdata.prefetch_to_device(iter([np.zeros(1)]), buffer_size=1)
+        assert len(list(it)) == 1
+        with pytest.raises(StopIteration):
+            next(it)
+        with pytest.raises(StopIteration):  # and keeps raising
+            next(it)
+
+    def test_error_keeps_raising(self):
+        def bad():
+            raise RuntimeError("boom")
+            yield  # pragma: no cover
+        it = hdata.prefetch_to_device(bad())
+        for _ in range(2):
+            with pytest.raises(RuntimeError, match="boom"):
+                next(it)
+
+    def test_close_mid_iteration_unblocks_worker(self):
+        started = threading.Event()
+
+        def src():
+            for i in range(100):
+                started.set()
+                yield np.zeros(1)
+
+        it = hdata.PrefetchIterator(src(), buffer_size=2, device_put=False)
+        started.wait(5)
+        next(it)
+        it.close()  # worker blocked on full queue must exit
+        assert not it._thread.is_alive()
+        with pytest.raises(StopIteration):
+            next(it)
+
+    def test_context_manager(self):
+        with hdata.PrefetchIterator(iter([np.zeros(1)] * 5),
+                                    device_put=False) as it:
+            next(it)
+        assert not it._thread.is_alive()
