@@ -66,7 +66,11 @@ def __getattr__(name):
                 "allreduce_sparse_as_dense", "sparse_to_dense"):
         from . import sparse
         return getattr(sparse, name)
-    if name in ("callbacks", "torch", "data", "checkpoint"):
+    if name == "Estimator":
+        from .estimator import Estimator
+        return Estimator
+    if name in ("callbacks", "torch", "data", "checkpoint",
+                "tensorflow", "keras", "spark"):
         # importlib, not `from . import x`: the fromlist lookup re-enters
         # this __getattr__ before sys.modules is populated (see `elastic`)
         import importlib

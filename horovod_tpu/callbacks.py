@@ -117,23 +117,31 @@ class BroadcastGlobalVariablesCallback(Callback):
         self._done = True
 
 
+def average_logs(logs, name_prefix: str = "metric") -> None:
+    """Average numeric scalar entries of ``logs`` across processes, in
+    place, in sorted-name order so every process submits the same
+    collective sequence (reference: _keras/callbacks.py:48-87). Shared by
+    the flax-loop and Keras MetricAverageCallback variants."""
+    if not logs:
+        return
+    from . import collectives as _c
+    for metric in sorted(logs):
+        value = logs[metric]
+        if isinstance(value, bool) or not (
+                isinstance(value, (int, float, np.floating, np.integer))
+                or (hasattr(value, "shape") and np.ndim(value) == 0)):
+            continue
+        out = _c.allreduce(np.asarray(value, np.float64), op=_c.Average,
+                           name=f"{name_prefix}.{metric}")
+        logs[metric] = float(np.asarray(out))
+
+
 class MetricAverageCallback(Callback):
     """Average the epoch-end metric logs across processes in place
-    (reference: _keras/callbacks.py:48-87). Metrics reduce in sorted-name
-    order so every process submits the same collective sequence."""
+    (reference: _keras/callbacks.py:48-87)."""
 
     def on_epoch_end(self, epoch, logs=None):
-        if not logs:
-            return
-        from . import collectives as _c
-        for metric in sorted(logs):
-            value = logs[metric]
-            if isinstance(value, (int, float, np.floating, np.integer)) or (
-                    hasattr(value, "shape") and np.ndim(value) == 0):
-                out = _c.allreduce(np.asarray(value, np.float64),
-                                   op=_c.Average,
-                                   name=f"metric.{metric}")
-                logs[metric] = float(np.asarray(out))
+        average_logs(logs, "metric")
 
 
 class LearningRateScheduleCallback(Callback):

@@ -1,0 +1,32 @@
+"""Keras interop: Keras-3 models trained with the TPU-hosted collective
+plane.
+
+Reference surface: horovod/keras + horovod/_keras
+(/root/reference/horovod/keras/__init__.py — DistributedOptimizer wrapping
+get_gradients; _keras/callbacks.py:22-190 — the callback family). With
+Keras 3, gradient interception moved to ``apply_gradients``
+(:func:`DistributedOptimizer` from the tensorflow module handles it); this
+module supplies the callbacks as real ``keras.callbacks.Callback``
+subclasses so they plug into ``model.fit``.
+
+Usage::
+
+    import horovod_tpu.keras as hvd
+    hvd.init()
+    model.compile(optimizer=hvd.DistributedOptimizer(opt), ...)
+    model.fit(x, y, callbacks=[
+        hvd.callbacks.BroadcastGlobalVariablesCallback(0),
+        hvd.callbacks.MetricAverageCallback(),
+    ])
+"""
+
+from ..basics import (  # noqa: F401
+    init, shutdown, is_initialized, rank, size, local_rank, local_size,
+)
+from ..collectives import Average, Sum, Adasum  # noqa: F401
+from ..tensorflow import (  # noqa: F401
+    DistributedOptimizer, allreduce, allgather, broadcast,
+    broadcast_variables,
+)
+
+from . import callbacks  # noqa: F401  (module at the end: imports keras)

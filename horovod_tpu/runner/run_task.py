@@ -10,10 +10,14 @@ import sys
 import traceback
 
 
-def main() -> int:
+def execute_from_store(rank: int):
+    """Fetch the pickled function from the rendezvous KV store (address
+    from the env contract), execute it, post the result, and return the
+    value. Raises on function failure. Used by the process stub below and
+    by in-task launchers (horovod_tpu.spark) that already run inside a
+    worker process."""
     addr = os.environ["HVD_TPU_RENDEZVOUS_ADDR"]
     port = int(os.environ["HVD_TPU_RENDEZVOUS_PORT"])
-    rank = int(os.environ.get("HVD_TPU_RANK", "0"))
 
     from .rendezvous import KVStoreClient
     client = KVStoreClient(addr, port)
@@ -21,12 +25,25 @@ def main() -> int:
     try:
         value = fn(*args, **kwargs)
         payload = {"value": value, "error": None}
-        code = 0
     except BaseException:
         payload = {"value": None, "error": traceback.format_exc()}
-        code = 1
+        client.put("run_result", str(rank), pickle.dumps(payload))
+        raise
     client.put("run_result", str(rank), pickle.dumps(payload))
-    return code
+    return value
+
+
+def main() -> int:
+    rank = int(os.environ.get("HVD_TPU_RANK", "0"))
+    try:
+        execute_from_store(rank)
+        return 0
+    except BaseException:
+        # infrastructure failures (rendezvous down, env missing) must leave
+        # a trace in the worker's launcher-prefixed stderr — the KV result
+        # payload may never have been posted
+        traceback.print_exc(file=sys.stderr)
+        return 1
 
 
 if __name__ == "__main__":

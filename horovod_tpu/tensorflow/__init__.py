@@ -1,0 +1,173 @@
+"""TensorFlow interop: TF2 eager training with the TPU-hosted collective
+plane.
+
+Reference surface: horovod/tensorflow (/root/reference/horovod/tensorflow/
+__init__.py — ``allreduce`` :52-131, ``DistributedGradientTape`` :465-518,
+``broadcast_variables`` in functions.py) re-exported process queries, and
+the broadcast hook. TF tensors bridge through host numpy, the same staging
+pattern as :mod:`horovod_tpu.torch` (reference's CPU-staging fallback,
+torch/mpi_ops_v2.cc:92+): TF in this stack is CPU-resident while jax owns
+the TPU.
+
+Usage (reference's TF2 recipe)::
+
+    import horovod_tpu.tensorflow as hvd
+    hvd.init()
+    with tf.GradientTape() as tape:
+        loss = loss_fn(model(x))
+    tape = hvd.DistributedGradientTape(tape)
+    grads = tape.gradient(loss, model.trainable_variables)
+    opt.apply_gradients(zip(grads, model.trainable_variables))
+    if first_batch:
+        hvd.broadcast_variables(model.variables, root_rank=0)
+"""
+
+from typing import Any, List, Optional
+
+import numpy as np
+
+from .. import collectives as _c
+from ..basics import (  # noqa: F401  (reference API parity re-exports)
+    init, shutdown, is_initialized, rank, size, local_rank, local_size,
+    cross_rank, cross_size,
+)
+from ..collectives import Average, Sum, Adasum  # noqa: F401
+
+
+def _tf():
+    import tensorflow as tf
+    return tf
+
+
+def allreduce(tensor, average=None, name: Optional[str] = None, op=None,
+              prescale_factor: float = 1.0, postscale_factor: float = 1.0):
+    """Allreduce of a tf.Tensor (reference: tensorflow/__init__.py:52-131).
+    tf.IndexedSlices take the gather path (reference :87-102)."""
+    tf = _tf()
+    if isinstance(tensor, tf.IndexedSlices):
+        from ..sparse import SparseGradient, allreduce_sparse
+        avg = op is None and (average is None or average) or op == Average
+        out = allreduce_sparse(
+            SparseGradient(indices=tensor.indices.numpy(),
+                           values=tensor.values.numpy(),
+                           dense_shape=tuple(tensor.dense_shape.numpy())),
+            average=bool(avg), name=name)
+        return tf.IndexedSlices(
+            values=tf.convert_to_tensor(np.asarray(out.values)),
+            indices=tf.convert_to_tensor(np.asarray(out.indices)),
+            dense_shape=tensor.dense_shape)
+    out = _c.allreduce(tensor.numpy(), average=average, name=name, op=op,
+                       prescale_factor=prescale_factor,
+                       postscale_factor=postscale_factor)
+    return tf.convert_to_tensor(np.asarray(out), dtype=tensor.dtype)
+
+
+def allgather(tensor, name: Optional[str] = None):
+    tf = _tf()
+    out = _c.allgather(tensor.numpy(), name=name)
+    return tf.convert_to_tensor(np.asarray(out), dtype=tensor.dtype)
+
+
+def broadcast(tensor, root_rank: int, name: Optional[str] = None):
+    tf = _tf()
+    out = _c.broadcast(tensor.numpy(), root_rank=root_rank, name=name)
+    return tf.convert_to_tensor(np.asarray(out), dtype=tensor.dtype)
+
+
+def broadcast_variables(variables: List, root_rank: int = 0) -> None:
+    """Assign every variable its root-rank value (reference:
+    tensorflow/functions.py broadcast_variables). Order is the caller's
+    list order, identical across processes by construction."""
+    for i, v in enumerate(variables):
+        name = f"bcast.var.{i}.{v.name if hasattr(v, 'name') else i}"
+        out = _c.broadcast(v.numpy(), root_rank=root_rank, name=name)
+        v.assign(np.asarray(out))
+
+
+def broadcast_object(obj: Any, root_rank: int = 0,
+                     name: Optional[str] = None):
+    from ..functions import broadcast_object as _bo
+    return _bo(obj, root_rank=root_rank, name=name)
+
+
+def _reduce_gradients(grads, op, name_prefix: str,
+                      prescale: float = 1.0, postscale: float = 1.0):
+    """Reduce a list of TF gradients (None entries pass through).
+
+    Eager tensors reduce directly. Inside a tf.function (Keras 3 traces
+    train_step), the whole list reduces through ONE ``tf.py_function`` node
+    running the fused eager grouped_allreduce — a single graph-side
+    submission point, so every process issues the identical collective
+    sequence regardless of TF's graph scheduling (the ordering guarantee
+    the reference gets from its background negotiation thread), and the
+    gradients fuse like the reference's fusion buffer.
+    """
+    tf = _tf()
+    present = [(i, g) for i, g in enumerate(grads) if g is not None]
+    if not present:
+        return list(grads)
+    dense = [
+        (i, tf.convert_to_tensor(g) if isinstance(g, tf.IndexedSlices)
+         else g)
+        for i, g in present]
+
+    def _eager_reduce(*tensors):
+        outs = _c.grouped_allreduce(
+            [np.asarray(t) for t in tensors], op=op,
+            name=name_prefix + ".grads",
+            prescale_factor=prescale, postscale_factor=postscale)
+        return [np.asarray(o) for o in outs]
+
+    symbolic = any(not hasattr(g, "numpy") for _, g in dense)
+    tensors = [g for _, g in dense]
+    if symbolic:
+        reduced = tf.py_function(
+            func=lambda *ts: _eager_reduce(*[t.numpy() for t in ts]),
+            inp=tensors, Tout=[g.dtype for g in tensors])
+        for r, (_, g) in zip(reduced, dense):
+            r.set_shape(g.shape)
+    else:
+        reduced = [tf.convert_to_tensor(o, dtype=g.dtype)
+                   for o, (_, g) in zip(_eager_reduce(*tensors), dense)]
+    out = list(grads)
+    for (i, _), r in zip(dense, reduced):
+        out[i] = r
+    return out
+
+
+class DistributedGradientTape:
+    """Wraps a tf.GradientTape so ``gradient()`` returns allreduced
+    gradients (reference: tensorflow/__init__.py:465-518)."""
+
+    def __init__(self, tape, op=Average, compression=None,
+                 prescale_factor: float = 1.0,
+                 postscale_factor: float = 1.0):
+        self._tape = tape
+        self._op = op
+        self._prescale = prescale_factor
+        self._postscale = postscale_factor
+
+    def gradient(self, target, sources, output_gradients=None):
+        grads = self._tape.gradient(target, sources, output_gradients)
+        return _reduce_gradients(grads, self._op, "tape",
+                                 self._prescale, self._postscale)
+
+    def __getattr__(self, item):
+        return getattr(self._tape, item)
+
+
+def DistributedOptimizer(optimizer, op=Average, name_prefix: str = "opt"):
+    """Wrap a keras/TF optimizer so ``apply_gradients`` reduces gradients
+    first (reference: tensorflow/__init__.py:259-301 _DistributedOptimizer
+    compute_gradients override; with Keras 3 the interception point is
+    apply_gradients)."""
+
+    def apply_gradients(grads_and_vars, *args, **kwargs):
+        gv = list(grads_and_vars)
+        reduced = _reduce_gradients([g for g, _ in gv], op, name_prefix)
+        return type(optimizer).apply_gradients(
+            optimizer, [(r, v) for r, (_, v) in zip(reduced, gv)],
+            *args, **kwargs)
+
+    optimizer.apply_gradients = apply_gradients
+    return optimizer

@@ -1,0 +1,90 @@
+"""TensorFlow / Keras interop tests (size-1 semantics, reference style:
+test_tensorflow.py degrades to single-process when run without a
+launcher)."""
+
+import numpy as np
+import pytest
+
+tf = pytest.importorskip("tensorflow")
+keras = pytest.importorskip("keras")
+
+
+class TestTensorFlow:
+    def test_collectives_roundtrip(self, hvd_world):
+        import horovod_tpu.tensorflow as hvd_tf
+        t = tf.constant([1.0, 2.0, 3.0])
+        np.testing.assert_allclose(
+            hvd_tf.allreduce(t, name="tf.ar").numpy(), t.numpy())
+        np.testing.assert_allclose(
+            hvd_tf.broadcast(t, 0, name="tf.bc").numpy(), t.numpy())
+        g = hvd_tf.allgather(tf.reshape(t, (3, 1)), name="tf.ag")
+        assert g.shape == (3, 1)
+
+    def test_indexed_slices_gather_path(self, hvd_world):
+        import horovod_tpu.tensorflow as hvd_tf
+        s = tf.IndexedSlices(values=tf.ones((2, 4)),
+                             indices=tf.constant([1, 3]),
+                             dense_shape=tf.constant([5, 4]))
+        out = hvd_tf.allreduce(s, name="tf.sparse")
+        assert isinstance(out, tf.IndexedSlices)
+        np.testing.assert_allclose(out.values.numpy(), np.ones((2, 4)))
+        np.testing.assert_array_equal(out.indices.numpy(), [1, 3])
+
+    def test_distributed_gradient_tape(self, hvd_world):
+        import horovod_tpu.tensorflow as hvd_tf
+        v = tf.Variable([1.0, 2.0])
+        with tf.GradientTape() as tape:
+            loss = tf.reduce_sum(v ** 2)
+        tape = hvd_tf.DistributedGradientTape(tape)
+        (grad,) = tape.gradient(loss, [v])
+        np.testing.assert_allclose(grad.numpy(), [2.0, 4.0])
+
+    def test_broadcast_variables(self, hvd_world):
+        import horovod_tpu.tensorflow as hvd_tf
+        v1 = tf.Variable([1.0, 2.0], name="a")
+        v2 = tf.Variable([[3.0]], name="b")
+        hvd_tf.broadcast_variables([v1, v2], root_rank=0)
+        np.testing.assert_allclose(v1.numpy(), [1.0, 2.0])
+        np.testing.assert_allclose(v2.numpy(), [[3.0]])
+
+
+class TestKeras:
+    def _model(self):
+        model = keras.Sequential([
+            keras.layers.Input((4,)),
+            keras.layers.Dense(8, activation="relu"),
+            keras.layers.Dense(1),
+        ])
+        return model
+
+    def test_fit_with_callbacks(self, hvd_world):
+        import horovod_tpu.keras as hvd_k
+        model = self._model()
+        opt = hvd_k.DistributedOptimizer(keras.optimizers.SGD(0.05))
+        model.compile(optimizer=opt, loss="mse")
+        x = np.random.RandomState(0).randn(64, 4).astype(np.float32)
+        y = (x.sum(axis=1, keepdims=True)).astype(np.float32)
+        hist = model.fit(
+            x, y, epochs=2, batch_size=16, verbose=0,
+            callbacks=[
+                hvd_k.callbacks.BroadcastGlobalVariablesCallback(0),
+                hvd_k.callbacks.MetricAverageCallback(),
+                hvd_k.callbacks.LearningRateWarmupCallback(
+                    initial_lr=0.05, warmup_epochs=1, steps_per_epoch=4),
+            ])
+        losses = hist.history["loss"]
+        assert losses[-1] < losses[0]  # trained
+        assert "lr" in hist.history
+
+    def test_lr_schedule_staircase(self, hvd_world):
+        import horovod_tpu.keras as hvd_k
+        model = self._model()
+        model.compile(optimizer=keras.optimizers.SGD(0.1), loss="mse")
+        x = np.zeros((8, 4), np.float32)
+        y = np.zeros((8, 1), np.float32)
+        cb = hvd_k.callbacks.LearningRateScheduleCallback(
+            initial_lr=0.1, multiplier=lambda e: 0.5 ** e)
+        model.fit(x, y, epochs=3, batch_size=4, verbose=0, callbacks=[cb])
+        np.testing.assert_allclose(
+            float(np.asarray(model.optimizer.learning_rate)),
+            0.1 * 0.5 ** 2, rtol=1e-5)
