@@ -141,6 +141,8 @@ def init(process_sets: Optional[Sequence[Sequence[int]]] = None,
             for i, ranks in enumerate(process_sets):
                 w.process_sets[i] = w.world_mesh.subset(list(ranks))
 
+        from .logging_setup import configure as _configure_logging
+        _configure_logging(cfg)
         from .timeline import maybe_start_timeline
         w.timeline = maybe_start_timeline(w)
         from .stall import StallInspector

@@ -1,0 +1,66 @@
+"""MXNet interop (import-gated).
+
+Reference surface: horovod/mxnet (/root/reference/horovod/mxnet/
+__init__.py:37-107 — DistributedOptimizer allreducing in ``update``, gluon
+DistributedTrainer, broadcast_parameters). MXNet is not part of this
+image's stack (it reached end-of-life upstream); the module gates with a
+clear error, and the collective plane it would bridge to is the same eager
+host plane used by :mod:`horovod_tpu.torch` — an NDArray bridge
+(asnumpy()/from numpy) is all an MXNet install would need, mirroring the
+torch module's design.
+"""
+
+from typing import Optional
+
+from ..basics import (  # noqa: F401
+    init, shutdown, is_initialized, rank, size, local_rank, local_size,
+)
+
+
+def _require_mxnet():
+    try:
+        import mxnet  # noqa: F401
+        return mxnet
+    except ImportError as e:
+        raise ImportError(
+            "horovod_tpu.mxnet requires mxnet, which is not installed in "
+            "this environment (MXNet is end-of-life upstream). Use the "
+            "jax/flax path (horovod_tpu), horovod_tpu.torch, or "
+            "horovod_tpu.tensorflow instead."
+        ) from e
+
+
+def allreduce(tensor, average: bool = True, name: Optional[str] = None):
+    mx = _require_mxnet()
+    from .. import collectives as _c
+    out = _c.allreduce(tensor.asnumpy(), average=average, name=name)
+    import numpy as np
+    return mx.nd.array(np.asarray(out), dtype=tensor.dtype)
+
+
+def broadcast_parameters(params, root_rank: int = 0):
+    mx = _require_mxnet()
+    import numpy as np
+    from .. import collectives as _c
+    items = sorted(params.items()) if isinstance(params, dict) \
+        else sorted(dict(params).items())
+    for name, p in items:
+        arr = p.data() if hasattr(p, "data") else p
+        out = _c.broadcast(arr.asnumpy(), root_rank=root_rank,
+                           name=f"mx.bcast.{name}")
+        arr[:] = mx.nd.array(np.asarray(out), dtype=arr.dtype)
+
+
+def DistributedOptimizer(optimizer):
+    """Wrap an mxnet optimizer so ``update`` allreduces gradients first
+    (reference: mxnet/__init__.py:37-76)."""
+    _require_mxnet()
+
+    class _Dist(type(optimizer)):
+        def update(self, index, weight, grad, state):
+            reduced = allreduce(grad, average=True,
+                                name=f"mx.grad.{index}")
+            super().update(index, weight, reduced, state)
+
+    optimizer.__class__ = _Dist
+    return optimizer
