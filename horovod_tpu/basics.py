@@ -172,6 +172,9 @@ def shutdown() -> None:
         if w is None:
             return
         w.shutdown_requested = True
+        d = getattr(w, "dispatcher", None)
+        if d is not None:
+            d.stop()
         if w.coordinator is not None:
             w.coordinator.stop()
         if w.timeline is not None:

@@ -31,6 +31,7 @@ reference's tests use when run without a launcher).
 """
 
 import enum
+import queue
 import threading
 from typing import List, Optional, Sequence
 
@@ -126,6 +127,126 @@ def _global_from_local(wm, local_np, extra_leading=True):
 def _local_result(out):
     """Read back this process's replica of a replicated jit output."""
     return out.addressable_data(0)
+
+
+# ---------------------------------------------------------------------------
+# Async dispatcher: the TPU-shaped descendant of the reference's background
+# thread + finalizer pool (operations.cc:557-607 RunLoopOnce,
+# gpu_operations.cc:60-87 FinalizeGPUQueue). ``*_async`` entry points hand a
+# staging+dispatch closure to this thread and return a handle immediately, so
+# the caller (e.g. torch's autograd engine firing grad hooks) overlaps its
+# backward pass with collective staging and device work. The single thread
+# also guarantees one process-wide total order of eager dispatches — the SPMD
+# correctness requirement the reference's rank-0 negotiation provided.
+# ---------------------------------------------------------------------------
+
+class _Dispatcher:
+    def __init__(self):
+        self._q: "queue.Queue" = queue.Queue()
+        self._stopped = False
+        self._thread = threading.Thread(
+            target=self._run, daemon=True, name="hvd-tpu-dispatcher")
+        self._thread.start()
+
+    def submit(self, h: Handle, fn) -> None:
+        h.event = threading.Event()
+        if self._stopped:
+            # shutdown raced with submission: fail the handle instead of
+            # enqueueing to a dead thread (reference: FinalizeTensorQueue
+            # flushes pending callbacks with SHUT_DOWN_ERROR)
+            h.error = HorovodInternalError(
+                "Horovod has been shut down; collective was not dispatched.")
+            h.event.set()
+            return
+        if threading.current_thread() is self._thread:
+            # Re-entrant submission from a dispatched closure (e.g. an
+            # autotuner broadcast inside a hook): run inline — we are already
+            # inside the serialized total order.
+            try:
+                h.result = fn()
+            except BaseException as e:  # noqa: BLE001 — surfaced at sync
+                h.error = _wrap_error(e)
+            finally:
+                h.event.set()
+            return
+        self._q.put((h, fn))
+
+    def run_sync(self, fn):
+        """Run ``fn`` on the dispatcher thread and wait — used by collectives
+        without an async variant so they stay in the single total order."""
+        box = {}
+        done = threading.Event()
+
+        def wrapper():
+            try:
+                box["result"] = fn()
+            except BaseException as e:  # noqa: BLE001 — re-raised in caller
+                box["error"] = e
+            finally:
+                done.set()
+
+        if threading.current_thread() is self._thread:
+            return fn()  # re-entrant call from a dispatched closure
+        if self._stopped:
+            raise HorovodInternalError(
+                "Horovod has been shut down; collective was not dispatched.")
+        self._q.put((None, wrapper))
+        done.wait()
+        if "error" in box:
+            raise box["error"]
+        return box["result"]
+
+    def _run(self):
+        while True:
+            item = self._q.get()
+            if item is None:
+                break
+            h, fn = item
+            if h is None:
+                fn()  # run_sync wrapper handles its own errors
+                continue
+            try:
+                h.result = fn()
+            except BaseException as e:  # noqa: BLE001 — surfaced at sync
+                h.error = _wrap_error(e)
+            finally:
+                h.event.set()
+        # drain anything enqueued concurrently with stop(): fail, don't hang
+        while True:
+            try:
+                item = self._q.get_nowait()
+            except queue.Empty:
+                return
+            if item is None:
+                continue
+            h, fn = item
+            if h is not None:
+                h.error = HorovodInternalError(
+                    "Horovod has been shut down; collective was not "
+                    "dispatched.")
+                h.event.set()
+            else:
+                fn()  # run_sync wrapper: unblock the waiter (fn may raise
+                # inside its own try, which the wrapper converts to an error)
+
+    def stop(self):
+        self._stopped = True
+        self._q.put(None)
+        self._thread.join(timeout=5.0)
+
+
+_dispatcher_lock = threading.Lock()
+
+
+def _dispatcher(w) -> _Dispatcher:
+    d = getattr(w, "dispatcher", None)
+    if d is None:
+        with _dispatcher_lock:
+            d = getattr(w, "dispatcher", None)
+            if d is None:
+                d = _Dispatcher()
+                w.dispatcher = d
+    return d
 
 
 def _response_cache(w):
@@ -312,25 +433,43 @@ def allreduce_async(tensor, average=None, name: Optional[str] = None,
                     op: Optional[ReduceOp] = None,
                     prescale_factor: float = 1.0,
                     postscale_factor: float = 1.0, process_set=None) -> int:
+    """Returns a handle immediately; staging + XLA dispatch happen on the
+    dispatcher thread so the caller (e.g. autograd firing grad hooks) overlaps
+    backward compute with communication (reference pipelining:
+    gpu_operations.cc:60-87)."""
     op = _resolve_op(average, op)
     w = _world()
     name = name or _auto_name("allreduce")
     h = _table(w).begin(name, "allreduce")
     tl = w.timeline
     tl.start(name, "allreduce")
+    wm = process_set or w.world_mesh
+    local = np.asarray(tensor)
     try:
-        wm = process_set or w.world_mesh
-        _check_consistency(w, wm, name, np.shape(tensor),
-                           np.asarray(tensor).dtype, "allreduce", op.value)
-        tl.activity_start(name, _tl.XLA_ALLREDUCE)
-        (out,) = _allreduce_impl(w, [tensor], op, prescale_factor,
-                                 postscale_factor, process_set)
-        tl.activity_end(name)
-        h.result = out
+        # Cheap argument validation stays on the caller thread so misuse
+        # raises at the call site (reference: Enqueue* rejects bad args
+        # synchronously).
+        _combined_scale(op, wm.num_procs, prescale_factor, postscale_factor,
+                        local.dtype)
     except Exception as e:
-        h.error = _wrap_error(e)
         _finish(w, h)
-        raise h.error from e
+        raise
+
+    # Snapshot join state at submit time: a collective submitted before
+    # join() must carry real data even if the dispatcher runs it after.
+    joined_at_submit = w.joined
+
+    def dispatch():
+        _check_consistency(w, wm, name, local.shape, local.dtype,
+                           "allreduce", op.value)
+        tl.activity_start(name, _tl.XLA_ALLREDUCE)
+        vals = [np.zeros_like(local)] if joined_at_submit else [local]
+        (out,) = _allreduce_impl(w, vals, op, prescale_factor,
+                                 postscale_factor, process_set, internal=True)
+        tl.activity_end(name)
+        return out
+
+    _dispatcher(w).submit(h, dispatch)
     return _register_async(w, h)
 
 
@@ -348,8 +487,9 @@ def grouped_allreduce(tensors: Sequence, average=None,
     names = [f"{base}.{i}" for i in range(len(tensors))]
     hs = [_table(w).begin(n, "grouped_allreduce") for n in names]
     try:
-        outs = _allreduce_impl(w, list(tensors), op, prescale_factor,
-                               postscale_factor, process_set)
+        outs = _dispatcher(w).run_sync(
+            lambda: _allreduce_impl(w, list(tensors), op, prescale_factor,
+                                    postscale_factor, process_set))
     except Exception as e:
         err = _wrap_error(e)
         for h in hs:
@@ -376,62 +516,61 @@ def allgather(tensor, name: Optional[str] = None, process_set=None):
 
 def allgather_async(tensor, name: Optional[str] = None, process_set=None) -> int:
     w = _world()
-    jax, jnp = _jax(), _jnp()
     name = name or _auto_name("allgather")
     h = _table(w).begin(name, "allgather")
     tl = w.timeline
     tl.start(name, "allgather")
-    try:
-        wm = process_set or w.world_mesh
+    wm = process_set or w.world_mesh
+    local = np.asarray(tensor)
+
+    def dispatch():
+        jax, jnp = _jax(), _jnp()
         nproc = wm.num_procs
-        local = np.asarray(tensor)
         # only non-first dims must match across processes
         _check_consistency(w, wm, name, local.shape[1:], local.dtype,
                            "allgather")
         if nproc == 1:
-            h.result = jnp.asarray(local)
+            return jnp.asarray(local)
+        tl.activity_start(name, _tl.XLA_ALLGATHER)
+        # 1) exchange first-dim sizes (the reference's negotiation of
+        #    per-rank sizes before allocating the allgatherv output)
+        sizes = _exchange_sizes(w, wm, local.shape[0] if local.ndim else 1)
+        dim0 = local.shape[0] if local.ndim else 1
+        maxd = int(sizes.max())
+        if all(int(s) == dim0 for s in sizes):
+            # uniform fast path: global array IS the gathered result
+            shape = (nproc * dim0,) + local.shape[1:]
+            shard = jax.device_put(local, wm.anchor_device)
+            garr = jax.make_array_from_single_device_arrays(
+                shape, wm.stacked_sharding(), [shard])
+
+            def build():
+                return jax.jit(lambda a: a,
+                               out_shardings=wm.replicated_sharding())
+            fn = _get_program(
+                w, ("allgather_uniform", nproc, wm.cache_key,
+                    shape, str(local.dtype)), build)
+            result = _local_result(fn(garr))
         else:
-            tl.activity_start(name, _tl.XLA_ALLGATHER)
-            # 1) exchange first-dim sizes (the reference's negotiation of
-            #    per-rank sizes before allocating the allgatherv output)
-            sizes = _exchange_sizes(w, wm, local.shape[0] if local.ndim else 1)
-            dim0 = local.shape[0] if local.ndim else 1
-            maxd = int(sizes.max())
-            if all(int(s) == dim0 for s in sizes):
-                # uniform fast path: global array IS the gathered result
-                shape = (nproc * dim0,) + local.shape[1:]
-                shard = jax.device_put(local, wm.anchor_device)
-                garr = jax.make_array_from_single_device_arrays(
-                    shape, wm.stacked_sharding(), [shard])
+            # ragged: pad to max, gather, slice+concat with static sizes
+            pad = maxd - dim0
+            padded = np.pad(local, [(0, pad)] + [(0, 0)] * (local.ndim - 1))
+            garr = _global_from_local(wm, padded)
+            sizes_t = tuple(int(s) for s in sizes)
 
-                def build():
-                    return jax.jit(lambda a: a,
-                                   out_shardings=wm.replicated_sharding())
-                fn = _get_program(
-                    w, ("allgather_uniform", nproc, wm.cache_key,
-                        shape, str(local.dtype)), build)
-                h.result = _local_result(fn(garr))
-            else:
-                # ragged: pad to max, gather, slice+concat with static sizes
-                pad = maxd - dim0
-                padded = np.pad(local, [(0, pad)] + [(0, 0)] * (local.ndim - 1))
-                garr = _global_from_local(wm, padded)
-                sizes_t = tuple(int(s) for s in sizes)
+            def build():
+                def f(a):
+                    parts = [a[i, :sizes_t[i]] for i in range(nproc)]
+                    return jnp.concatenate(parts, axis=0)
+                return jax.jit(f, out_shardings=wm.replicated_sharding())
+            fn = _get_program(
+                w, ("allgather_ragged", nproc, wm.cache_key, sizes_t,
+                    padded.shape, str(local.dtype)), build)
+            result = _local_result(fn(garr))
+        tl.activity_end(name)
+        return result
 
-                def build():
-                    def f(a):
-                        parts = [a[i, :sizes_t[i]] for i in range(nproc)]
-                        return jnp.concatenate(parts, axis=0)
-                    return jax.jit(f, out_shardings=wm.replicated_sharding())
-                fn = _get_program(
-                    w, ("allgather_ragged", nproc, wm.cache_key, sizes_t,
-                        padded.shape, str(local.dtype)), build)
-                h.result = _local_result(fn(garr))
-            tl.activity_end(name)
-    except Exception as e:
-        h.error = _wrap_error(e)
-        _finish(w, h)
-        raise h.error from e
+    _dispatcher(w).submit(h, dispatch)
     return _register_async(w, h)
 
 
@@ -461,38 +600,38 @@ def broadcast(tensor, root_rank: int, name: Optional[str] = None,
 def broadcast_async(tensor, root_rank: int, name: Optional[str] = None,
                     process_set=None) -> int:
     w = _world()
-    jax, jnp = _jax(), _jnp()
     name = name or _auto_name("broadcast")
     h = _table(w).begin(name, "broadcast")
     tl = w.timeline
     tl.start(name, "broadcast")
-    try:
-        wm = process_set or w.world_mesh
-        nproc = wm.num_procs
-        local = np.asarray(tensor)
+    wm = process_set or w.world_mesh
+    nproc = wm.num_procs
+    local = np.asarray(tensor)
+    if not (0 <= root_rank < nproc):
+        _finish(w, h)
+        raise ValueError(f"root_rank {root_rank} out of range for world "
+                         f"size {nproc}")
+
+    def dispatch():
+        jax, jnp = _jax(), _jnp()
         _check_consistency(w, wm, name, local.shape, local.dtype,
                            "broadcast", str(root_rank))
-        if not (0 <= root_rank < nproc):
-            raise ValueError(f"root_rank {root_rank} out of range for world "
-                             f"size {nproc}")
         if nproc == 1:
-            h.result = jnp.asarray(local)
-        else:
-            tl.activity_start(name, _tl.XLA_BROADCAST)
-            garr = _global_from_local(wm, local)
+            return jnp.asarray(local)
+        tl.activity_start(name, _tl.XLA_BROADCAST)
+        garr = _global_from_local(wm, local)
 
-            def build():
-                return jax.jit(lambda a: a[root_rank],
-                               out_shardings=wm.replicated_sharding())
-            fn = _get_program(
-                w, ("broadcast", nproc, wm.cache_key, root_rank,
-                    local.shape, str(local.dtype)), build)
-            h.result = _local_result(fn(garr))
-            tl.activity_end(name)
-    except Exception as e:
-        h.error = _wrap_error(e)
-        _finish(w, h)
-        raise h.error from e
+        def build():
+            return jax.jit(lambda a: a[root_rank],
+                           out_shardings=wm.replicated_sharding())
+        fn = _get_program(
+            w, ("broadcast", nproc, wm.cache_key, root_rank,
+                local.shape, str(local.dtype)), build)
+        result = _local_result(fn(garr))
+        tl.activity_end(name)
+        return result
+
+    _dispatcher(w).submit(h, dispatch)
     return _register_async(w, h)
 
 
@@ -505,17 +644,14 @@ def alltoall(tensor, splits=None, name: Optional[str] = None, process_set=None):
     slices, concatenated along dim 0. ``splits`` (optional, len = world size)
     gives per-destination row counts; default is an even split."""
     w = _world()
-    jax, jnp = _jax(), _jnp()
     name = name or _auto_name("alltoall")
     h = _table(w).begin(name, "alltoall")
     tl = w.timeline
     tl.start(name, "alltoall")
+    wm = process_set or w.world_mesh
+    nproc = wm.num_procs
+    local = np.asarray(tensor)
     try:
-        wm = process_set or w.world_mesh
-        nproc = wm.num_procs
-        local = np.asarray(tensor)
-        _check_consistency(w, wm, name, local.shape[1:], local.dtype,
-                           "alltoall")
         if splits is None:
             if local.shape[0] % nproc != 0:
                 raise ValueError(
@@ -526,44 +662,49 @@ def alltoall(tensor, splits=None, name: Optional[str] = None, process_set=None):
         if len(splits) != nproc or sum(splits) != local.shape[0]:
             raise ValueError("splits must have one entry per process and sum "
                              "to the tensor's first dimension")
-        if nproc == 1:
-            h.result = jnp.asarray(local)
-        else:
-            tl.activity_start(name, _tl.XLA_ALLTOALL)
-            # exchange split tables so each process knows incoming sizes
-            split_tbl = _exchange_split_table(w, wm, splits)
-            maxs = int(split_tbl.max())
-            # pad each outgoing chunk to maxs rows: (nproc, maxs, rest)
-            rest = local.shape[1:]
-            chunks = np.zeros((nproc, maxs) + rest, dtype=local.dtype)
-            off = 0
-            for j, s in enumerate(splits):
-                chunks[j, :s] = local[off:off + s]
-                off += s
-            garr = _global_from_local(wm, chunks)  # (src, dst, maxs, *rest)
-
-            # NOTE: the jitted exchange must be IDENTICAL on every process
-            # (one SPMD program); per-process unpacking happens locally below.
-            def build():
-                return jax.jit(lambda a: jnp.swapaxes(a, 0, 1),
-                               out_shardings=wm.stacked_sharding())
-            fn = _get_program(
-                w, ("alltoall", nproc, wm.cache_key, chunks.shape,
-                    str(local.dtype)), build)
-            # my shard: (1, src, maxs, *rest) — rows every src sent to me
-            mine = np.asarray(_local_result(fn(garr)))[0]
-            incoming = [int(split_tbl[src, wm.my_index])
-                        for src in range(nproc)]
-            h.result = jnp.concatenate(
-                [jnp.asarray(mine[s, :incoming[s]]) for s in range(nproc)],
-                axis=0)
-            tl.activity_end(name)
-    except Exception as e:
-        h.error = _wrap_error(e)
+    except Exception:
         _finish(w, h)
-        raise h.error from e
-    hid = _register_async(w, h)
-    return synchronize(hid)
+        raise
+
+    def dispatch():
+        jax, jnp = _jax(), _jnp()
+        _check_consistency(w, wm, name, local.shape[1:], local.dtype,
+                           "alltoall")
+        if nproc == 1:
+            return jnp.asarray(local)
+        tl.activity_start(name, _tl.XLA_ALLTOALL)
+        # exchange split tables so each process knows incoming sizes
+        split_tbl = _exchange_split_table(w, wm, splits)
+        maxs = int(split_tbl.max())
+        # pad each outgoing chunk to maxs rows: (nproc, maxs, rest)
+        rest = local.shape[1:]
+        chunks = np.zeros((nproc, maxs) + rest, dtype=local.dtype)
+        off = 0
+        for j, s in enumerate(splits):
+            chunks[j, :s] = local[off:off + s]
+            off += s
+        garr = _global_from_local(wm, chunks)  # (src, dst, maxs, *rest)
+
+        # NOTE: the jitted exchange must be IDENTICAL on every process
+        # (one SPMD program); per-process unpacking happens locally below.
+        def build():
+            return jax.jit(lambda a: jnp.swapaxes(a, 0, 1),
+                           out_shardings=wm.stacked_sharding())
+        fn = _get_program(
+            w, ("alltoall", nproc, wm.cache_key, chunks.shape,
+                str(local.dtype)), build)
+        # my shard: (1, src, maxs, *rest) — rows every src sent to me
+        mine = np.asarray(_local_result(fn(garr)))[0]
+        incoming = [int(split_tbl[src, wm.my_index])
+                    for src in range(nproc)]
+        result = jnp.concatenate(
+            [jnp.asarray(mine[s, :incoming[s]]) for s in range(nproc)],
+            axis=0)
+        tl.activity_end(name)
+        return result
+
+    _dispatcher(w).submit(h, dispatch)
+    return synchronize(h.id)
 
 
 def _exchange_split_table(w, wm, splits) -> np.ndarray:
@@ -600,6 +741,8 @@ def poll(handle: int) -> bool:
     (reference: torch/mpi_ops.py:476-485)."""
     w = _world()
     h = _table(w).get(handle)
+    if h.event is not None and not h.event.is_set():
+        return False  # still queued or staging on the dispatcher thread
     if h.error is not None:
         return True
     r = h.result
@@ -619,6 +762,12 @@ def synchronize(handle: int):
     w = _world()
     h = _table(w).get(handle)
     try:
+        if h.event is not None:
+            # wait for the dispatcher thread, honoring the stall deadline
+            insp = w.stall_inspector
+            while not h.event.wait(timeout=0.05 if insp is not None else None):
+                if insp is not None:
+                    insp.check_shutdown()
         if h.error is not None:
             raise h.error
         r = h.result

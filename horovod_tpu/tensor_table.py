@@ -38,13 +38,17 @@ class Handle:
     """An in-flight collective. Resolved by ``synchronize()``/``poll()``
     (reference: torch/mpi_ops.py:463-517)."""
 
-    __slots__ = ("id", "name", "result", "error", "_ready_fn", "_finalize_fn")
+    __slots__ = ("id", "name", "result", "error", "event", "_ready_fn",
+                 "_finalize_fn")
 
     def __init__(self, hid: int, name: str):
         self.id = hid
         self.name = name
         self.result = None
         self.error: Optional[BaseException] = None
+        # Set once the dispatcher thread has produced result/error. None for
+        # ops that completed inline (dispatch already done at submit time).
+        self.event: Optional[threading.Event] = None
         self._ready_fn: Optional[Callable[[], bool]] = None
         self._finalize_fn: Optional[Callable[[], Any]] = None
 

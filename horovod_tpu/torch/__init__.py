@@ -5,10 +5,13 @@ per-parameter grad hooks that fire async allreduces, synchronized in
 ``step()`` (/root/reference/horovod/torch/optimizer.py:100-186), plus
 ``broadcast_parameters``/``broadcast_optimizer_state``
 (torch/functions.py). Here the collectives are horovod_tpu's eager plane
-(XLA over ICI/DCN); torch tensors bridge through host numpy — the analogue
-of the reference's ``*CudaOnCPU`` staging path (torch/mpi_ops_v2.cc:92+),
-appropriate because torch in this stack is CPU-resident while jax owns the
-TPU.
+(XLA over ICI/DCN); torch tensors bridge through **DLPack** — zero-copy on
+CPU-resident tensors (the analogue of the reference's adapter layer,
+torch/mpi_ops_v2.cc + adapter_v2.cc) — with a numpy copy as the fallback for
+layouts DLPack can't express. Async ops return handles whose staging and
+dispatch run on the collective dispatcher thread, so the autograd engine's
+backward pass overlaps communication (reference: gpu_operations.cc:60-87
+finalizer pipelining).
 
 Usage (identical shape to the reference's 5-line recipe)::
 
@@ -20,6 +23,7 @@ Usage (identical shape to the reference's 5-line recipe)::
     hvd.broadcast_parameters(model.state_dict(), root_rank=0)
 """
 
+import threading
 from typing import Any, Dict, Iterator, Optional, Tuple
 
 import numpy as np
@@ -31,25 +35,54 @@ from ..basics import (  # noqa: F401  (reference API parity re-exports)
     cross_rank, cross_size,
 )
 from ..collectives import (  # noqa: F401
-    Average, Sum, Adasum, poll, synchronize as _synchronize_handle, join,
+    Average, Sum, Adasum, poll, join,
 )
+from ..compression import Compression  # noqa: F401
 
 
 def _to_numpy(t) -> np.ndarray:
-    return t.detach().cpu().numpy()
+    """torch tensor -> numpy, zero-copy via DLPack whenever the memory is
+    CPU-resident and expressible (bfloat16 crosses via a bit-pattern view
+    into ml_dtypes.bfloat16, still zero-copy)."""
+    import torch
+    t = t.detach()
+    if t.device.type != "cpu":
+        t = t.cpu()
+    if t.dtype == torch.bfloat16:
+        import ml_dtypes
+        return (t.contiguous().view(torch.uint16).numpy()
+                .view(ml_dtypes.bfloat16))
+    try:
+        return np.from_dlpack(t)
+    except Exception:
+        return t.numpy() if t.is_contiguous() else t.contiguous().numpy()
 
 
 def _from_numpy(a, dtype):
-    """jax/numpy result -> torch tensor of the requested dtype (single
-    bridging point: jax arrays are non-writable, so copy)."""
+    """jax/numpy result -> torch tensor of the requested dtype. DLPack
+    import (zero-copy for CPU-backed jax arrays) with a numpy-copy fallback;
+    the result buffer is exclusively ours once the handle is finished, so the
+    shared view is safe to hand out."""
     import torch
-    return torch.from_numpy(np.array(a)).to(dtype)
+    try:
+        t = torch.from_dlpack(a)
+    except Exception:
+        arr = np.asarray(a)
+        if arr.dtype.name == "bfloat16":
+            t = torch.from_numpy(
+                arr.view(np.uint16).copy()).view(torch.bfloat16)
+        else:
+            t = torch.from_numpy(np.array(arr))
+    return t.to(dtype) if t.dtype != dtype else t
 
 
-def allreduce(tensor, average=None, name: Optional[str] = None, op=None):
+def allreduce(tensor, average=None, name: Optional[str] = None, op=None,
+              prescale_factor: float = 1.0, postscale_factor: float = 1.0):
     """Synchronous allreduce of a torch tensor; returns a torch tensor
     (reference: torch/mpi_ops.py:158-200)."""
-    out = _c.allreduce(_to_numpy(tensor), average=average, name=name, op=op)
+    out = _c.allreduce(_to_numpy(tensor), average=average, name=name, op=op,
+                       prescale_factor=prescale_factor,
+                       postscale_factor=postscale_factor)
     return _from_numpy(out, tensor.dtype)
 
 
@@ -61,6 +94,54 @@ def allgather(tensor, name: Optional[str] = None):
 def broadcast(tensor, root_rank: int, name: Optional[str] = None):
     out = _c.broadcast(_to_numpy(tensor), root_rank=root_rank, name=name)
     return _from_numpy(out, tensor.dtype)
+
+
+def alltoall(tensor, splits=None, name: Optional[str] = None):
+    out = _c.alltoall(_to_numpy(tensor), splits=splits, name=name)
+    return _from_numpy(out, tensor.dtype)
+
+
+# -- async handle API (reference: torch/mpi_ops.py:463-517) ------------------
+
+_handle_meta: Dict[int, Any] = {}
+_handle_meta_lock = threading.Lock()
+
+
+def allreduce_async(tensor, average=None, name: Optional[str] = None,
+                    op=None, prescale_factor: float = 1.0,
+                    postscale_factor: float = 1.0) -> int:
+    h = _c.allreduce_async(_to_numpy(tensor), average=average, name=name,
+                           op=op, prescale_factor=prescale_factor,
+                           postscale_factor=postscale_factor)
+    with _handle_meta_lock:
+        _handle_meta[h] = tensor.dtype
+    return h
+
+
+def allgather_async(tensor, name: Optional[str] = None) -> int:
+    h = _c.allgather_async(_to_numpy(tensor), name=name)
+    with _handle_meta_lock:
+        _handle_meta[h] = tensor.dtype
+    return h
+
+
+def broadcast_async(tensor, root_rank: int, name: Optional[str] = None) -> int:
+    h = _c.broadcast_async(_to_numpy(tensor), root_rank=root_rank, name=name)
+    with _handle_meta_lock:
+        _handle_meta[h] = tensor.dtype
+    return h
+
+
+def synchronize(handle: int):
+    """Wait for an async op; returns the result as a torch tensor when the
+    handle was created through this module, else the raw array."""
+    with _handle_meta_lock:
+        dtype = _handle_meta.pop(handle, None)
+    out = _c.synchronize(handle)
+    return _from_numpy(out, dtype) if dtype is not None else out
+
+
+_synchronize_handle = _c.synchronize
 
 
 def broadcast_parameters(params, root_rank: int = 0) -> None:
@@ -132,12 +213,15 @@ class _DistributedOptimizer:
     torch/optimizer.py:100-186)."""
 
     def __init__(self, optimizer, named_parameters=None, op=_c.Average,
-                 backward_passes_per_step: int = 1):
+                 backward_passes_per_step: int = 1,
+                 compression=Compression.none):
         self._opt = optimizer
         self._op = op
         self._bpps = backward_passes_per_step
+        self._compression = compression
         self._pass_count: Dict[int, int] = {}
         self._handles: Dict[Any, int] = {}
+        self._ctxs: Dict[Any, Any] = {}
         self._names: Dict[Any, str] = {}
         all_params = [p for group in optimizer.param_groups
                       for p in group["params"]]
@@ -185,8 +269,12 @@ class _DistributedOptimizer:
                 grad = _to_numpy(p.grad)
                 if self._bpps > 1:
                     grad = grad / self._bpps
+                # compress on the wire (reference: torch/optimizer.py:111-117
+                # compression hook); decompressed in synchronize()
+                compressed, ctx = self._compression.compress(grad)
+                self._ctxs[p] = ctx
                 self._handles[p] = _c.allreduce_async(
-                    grad, op=self._op,
+                    compressed, op=self._op,
                     name=f"grad.{self._names[p]}")
         return hook
 
@@ -195,6 +283,7 @@ class _DistributedOptimizer:
         import torch
         for p, h in list(self._handles.items()):
             out = _synchronize_handle(h)
+            out = self._compression.decompress(out, self._ctxs.pop(p, None))
             with torch.no_grad():
                 p.grad.copy_(_from_numpy(out, p.grad.dtype))
         self._handles.clear()
@@ -225,7 +314,19 @@ class _DistributedOptimizer:
 
 
 def DistributedOptimizer(optimizer, named_parameters=None, op=_c.Average,
-                         backward_passes_per_step: int = 1):
+                         backward_passes_per_step: int = 1,
+                         compression=Compression.none):
     return _DistributedOptimizer(
         optimizer, named_parameters=named_parameters, op=op,
-        backward_passes_per_step=backward_passes_per_step)
+        backward_passes_per_step=backward_passes_per_step,
+        compression=compression)
+
+
+def __getattr__(name):  # PEP 562 lazy exports (torch import stays deferred)
+    if name == "SyncBatchNorm":
+        from .sync_batch_norm import get_sync_batch_norm_class
+        return get_sync_batch_norm_class()
+    if name == "elastic":
+        import importlib
+        return importlib.import_module(".elastic", __name__)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

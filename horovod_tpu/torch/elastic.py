@@ -1,0 +1,70 @@
+"""Elastic state for PyTorch models/optimizers.
+
+Reference: /root/reference/horovod/torch/elastic.py:51-85 — ``TorchState``
+holds a model and optimizer, snapshots their ``state_dict()`` to host memory
+on ``save()``, rolls back on ``restore()``, and re-seeds restarted workers
+from rank 0 on ``sync()`` via parameter/optimizer-state broadcast.
+"""
+
+import copy
+from typing import Optional
+
+from ..elastic.run import run, run_fn  # noqa: F401  (reference re-export)
+from ..elastic.state import ObjectState
+from . import broadcast_optimizer_state, broadcast_parameters
+
+
+class TorchState(ObjectState):
+    """Elastic state wrapping a torch model + optimizer plus plain attrs.
+
+    Usage (reference recipe)::
+
+        state = hvd.elastic.TorchState(model, optimizer, epoch=0, batch=0)
+
+        @hvd.elastic.run
+        def train(state):
+            for epoch in range(state.epoch, epochs):
+                ...
+                state.commit()
+    """
+
+    def __init__(self, model=None, optimizer=None, **kwargs):
+        self.model = model
+        self.optimizer = optimizer
+        self._model_state = (
+            copy.deepcopy(model.state_dict()) if model is not None else None)
+        self._opt_state = (
+            copy.deepcopy(optimizer.state_dict())
+            if optimizer is not None else None)
+        bcast_object = kwargs.pop("bcast_object", None)
+        get_rank = kwargs.pop("get_rank", None)
+        super().__init__(bcast_object=bcast_object, get_rank=get_rank,
+                         **kwargs)
+
+    def save(self) -> None:
+        if self.model is not None:
+            self._model_state = copy.deepcopy(self.model.state_dict())
+        if self.optimizer is not None:
+            self._opt_state = copy.deepcopy(self.optimizer.state_dict())
+        super().save()
+
+    def restore(self) -> None:
+        if self.model is not None and self._model_state is not None:
+            self.model.load_state_dict(self._model_state)
+        if self.optimizer is not None and self._opt_state is not None:
+            self.optimizer.load_state_dict(self._opt_state)
+        super().restore()
+
+    def sync(self) -> None:
+        """Broadcast rank 0's live model/optimizer state to every worker,
+        then make the synced values the committed snapshot (reference:
+        torch/elastic.py TorchState.sync)."""
+        if self.model is not None:
+            broadcast_parameters(self.model.state_dict(), root_rank=0)
+        if self.optimizer is not None:
+            broadcast_optimizer_state(self.optimizer, root_rank=0)
+        if self.model is not None:
+            self._model_state = copy.deepcopy(self.model.state_dict())
+        if self.optimizer is not None:
+            self._opt_state = copy.deepcopy(self.optimizer.state_dict())
+        super().sync()

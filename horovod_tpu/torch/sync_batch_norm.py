@@ -1,0 +1,140 @@
+"""Cross-process synchronized BatchNorm for torch models.
+
+Reference: /root/reference/horovod/torch/sync_batch_norm.py — a
+``_SyncBatchNorm`` module whose forward gathers per-rank batch statistics and
+whose backward allreduces the gradient statistics, so every worker normalizes
+with the *global* batch mean/var. The reference builds on CUDA-only kernels
+(``torch.batch_norm_stats``/``batch_norm_gather_stats_with_counts``); this
+implementation computes the same math directly on CPU tensors (the torch side
+of this stack is CPU-resident) and runs the cross-process sums through the
+eager XLA collective plane.
+
+Math (identical to the reference's underlying kernels):
+  forward:  global mean/var from allreduced (sum, sqsum, count)
+  backward: grad_input = (dy - mean(dy) - xhat * mean(dy * xhat)) * invstd * w
+            with mean() taken over the GLOBAL batch via allreduce.
+"""
+
+from .. import basics as _basics
+
+
+def _allreduce_sum(t, name: str):
+    """Sum-allreduce a 1-D fp32 torch tensor across processes. The name must
+    be identical on every process (controller.cc:378-611 validation)."""
+    from . import _from_numpy, _to_numpy
+    from .. import collectives as _c
+    out = _c.allreduce(_to_numpy(t), op=_c.Sum, name=name)
+    return _from_numpy(out, t.dtype)
+
+
+def _make_function():
+    import torch
+
+    class _SyncBatchNormFn(torch.autograd.Function):
+        @staticmethod
+        def forward(ctx, input, weight, bias, eps):
+            dims = [0] + list(range(2, input.dim()))
+            count = input.numel() // input.size(1)
+            f32 = input.float()
+            local = torch.cat([
+                f32.sum(dims), (f32 * f32).sum(dims),
+                torch.tensor([float(count)])])
+            glob = _allreduce_sum(local, "sync_bn.fwd_stats")
+            c = input.size(1)
+            g_sum, g_sqsum, g_count = glob[:c], glob[c:2 * c], glob[2 * c]
+            mean = g_sum / g_count
+            var = g_sqsum / g_count - mean * mean
+            invstd = torch.rsqrt(var + eps)
+
+            shape = [1, c] + [1] * (input.dim() - 2)
+            xhat = (f32 - mean.view(shape)) * invstd.view(shape)
+            out = xhat
+            if weight is not None:
+                out = out * weight.float().view(shape)
+            if bias is not None:
+                out = out + bias.float().view(shape)
+            ctx.save_for_backward(xhat, weight, invstd)
+            ctx.g_count = g_count
+            ctx.mark_non_differentiable(mean, var, g_count)
+            return out.to(input.dtype), mean, var, g_count
+
+        @staticmethod
+        def backward(ctx, grad_output, _gmean, _gvar, _gcount):
+            xhat, weight, invstd = ctx.saved_tensors
+            dims = [0] + list(range(2, grad_output.dim()))
+            c = grad_output.size(1)
+            shape = [1, c] + [1] * (grad_output.dim() - 2)
+            dy = grad_output.float()
+
+            grad_weight = (dy * xhat).sum(dims) if weight is not None else None
+            grad_bias = dy.sum(dims)
+
+            # global sums of dy and dy*xhat drive grad_input (the reference's
+            # batch_norm_backward_elemt math with allreduced mean terms)
+            local = torch.cat([dy.sum(dims), (dy * xhat).sum(dims)])
+            glob = _allreduce_sum(local, "sync_bn.bwd_stats")
+            sum_dy, sum_dy_xhat = glob[:c], glob[c:]
+            n = ctx.g_count
+            w = weight.float().view(shape) if weight is not None else 1.0
+            grad_input = (
+                (dy - (sum_dy / n).view(shape)
+                 - xhat * (sum_dy_xhat / n).view(shape))
+                * invstd.view(shape) * w)
+            return (grad_input.to(grad_output.dtype),
+                    grad_weight.to(weight.dtype) if weight is not None
+                    else None,
+                    grad_bias.to(grad_output.dtype), None)
+
+    return _SyncBatchNormFn
+
+
+_cache = {}
+
+
+def _fn():
+    if "fn" not in _cache:
+        _cache["fn"] = _make_function()
+    return _cache["fn"]
+
+
+def get_sync_batch_norm_class():
+    if "cls" in _cache:
+        return _cache["cls"]
+    import torch
+
+    class SyncBatchNorm(torch.nn.modules.batchnorm._BatchNorm):
+        """Drop-in BatchNorm whose statistics are synchronized across the
+        horovod_tpu world (reference: torch/sync_batch_norm.py
+        SyncBatchNorm)."""
+
+        def _check_input_dim(self, input):
+            if input.dim() < 2:
+                raise ValueError(
+                    f"expected at least 2D input (got {input.dim()}D)")
+
+        def forward(self, input):
+            self._check_input_dim(input)
+            # single process or eval mode: identical to vanilla BatchNorm
+            # (reference: falls back when not training or size == 1)
+            if not self.training or _basics.size() == 1:
+                return super().forward(input)
+
+            out, mean, var, g_count = _fn().apply(
+                input, self.weight, self.bias, self.eps)
+
+            if self.track_running_stats:
+                with torch.no_grad():
+                    unbiased = var * g_count / max(float(g_count) - 1, 1.0)
+                    if self.num_batches_tracked is not None:
+                        self.num_batches_tracked += 1
+                    m = self.momentum
+                    if m is None:
+                        m = 1.0 / float(self.num_batches_tracked)
+                    self.running_mean.mul_(1 - m).add_(
+                        mean.to(self.running_mean.dtype) * m)
+                    self.running_var.mul_(1 - m).add_(
+                        unbiased.to(self.running_var.dtype) * m)
+            return out
+
+    _cache["cls"] = SyncBatchNorm
+    return SyncBatchNorm

@@ -1,0 +1,110 @@
+"""Torch parity depth: SyncBatchNorm numerics and TorchState elastic state.
+
+Reference tests being mirrored: test_torch.py sync-BN equivalence (the
+reference validates SyncBatchNorm against vanilla BatchNorm when world size
+is 1 / stats are equal) and torch/elastic.py TorchState save/restore/sync.
+"""
+
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+
+
+def test_sync_bn_matches_vanilla_bn(hvd_world, monkeypatch):
+    """With one process the synchronized math must equal vanilla BatchNorm,
+    including gradients. Forces the sync path by patching size()."""
+    import horovod_tpu.torch as hvd_t
+    from horovod_tpu.torch import sync_batch_norm as sbn
+
+    cls = hvd_t.SyncBatchNorm
+    monkeypatch.setattr(sbn._basics, "size", lambda: 2)
+
+    torch.manual_seed(0)
+    x = torch.randn(4, 3, 5, 5, dtype=torch.float64).float()
+    x1 = x.clone().requires_grad_(True)
+    x2 = x.clone().requires_grad_(True)
+
+    sync = cls(3)
+    ref = torch.nn.BatchNorm2d(3)
+    ref.load_state_dict({k: v.clone() for k, v in sync.state_dict().items()})
+    sync.train()
+    ref.train()
+
+    y1 = sync(x1)
+    y2 = ref(x2)
+    torch.testing.assert_close(y1, y2, rtol=1e-4, atol=1e-5)
+
+    y1.sum().backward()
+    y2.sum().backward()
+    torch.testing.assert_close(x1.grad, x2.grad, rtol=1e-4, atol=1e-5)
+    torch.testing.assert_close(sync.weight.grad, ref.weight.grad,
+                               rtol=1e-4, atol=1e-5)
+    torch.testing.assert_close(sync.bias.grad, ref.bias.grad,
+                               rtol=1e-4, atol=1e-5)
+    # running stats updated the same way
+    torch.testing.assert_close(sync.running_mean, ref.running_mean,
+                               rtol=1e-4, atol=1e-5)
+    torch.testing.assert_close(sync.running_var, ref.running_var,
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_sync_bn_eval_falls_back(hvd_world):
+    import horovod_tpu.torch as hvd_t
+    bn = hvd_t.SyncBatchNorm(4)
+    bn.eval()
+    x = torch.randn(2, 4)
+    out = bn(x)
+    assert out.shape == x.shape
+
+
+def test_torch_state_commit_restore(hvd_world):
+    import horovod_tpu.torch as hvd_t
+    from horovod_tpu.torch.elastic import TorchState
+
+    model = torch.nn.Linear(3, 2)
+    opt = torch.optim.SGD(model.parameters(), lr=0.1, momentum=0.9)
+    state = TorchState(model, opt, epoch=0, batch=0)
+
+    # train one step and commit
+    model(torch.ones(2, 3)).sum().backward()
+    opt.step()
+    state.epoch = 1
+    state.commit()
+    committed = {k: v.clone() for k, v in model.state_dict().items()}
+
+    # corrupt, then restore
+    with torch.no_grad():
+        for p in model.parameters():
+            p.mul_(0.0)
+    state.epoch = 7
+    state.restore()
+    for k, v in model.state_dict().items():
+        torch.testing.assert_close(v, committed[k])
+    assert state.epoch == 1
+
+    # sync() runs end-to-end (world size 1: broadcast is identity)
+    state.sync()
+    for k, v in model.state_dict().items():
+        torch.testing.assert_close(v, committed[k])
+
+
+def test_torch_elastic_run_decorator(hvd_world):
+    import horovod_tpu.torch as hvd_t
+    from horovod_tpu.torch.elastic import TorchState
+
+    model = torch.nn.Linear(2, 2)
+    opt = torch.optim.SGD(model.parameters(), lr=0.1)
+    state = TorchState(model, opt, steps=0)
+
+    @hvd_t.elastic.run
+    def train(state):
+        for _ in range(3):
+            opt.zero_grad()
+            model(torch.ones(1, 2)).sum().backward()
+            opt.step()
+            state.steps += 1
+            state.commit()
+        return state.steps
+
+    assert train(state) == 3
