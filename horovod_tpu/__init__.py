@@ -39,7 +39,7 @@ from .collectives import (  # noqa: F401
     allgather, allgather_async,
     broadcast, broadcast_async,
     alltoall,
-    poll, synchronize, join, joined, barrier,
+    poll, synchronize, join, join_round, joined, barrier,
 )
 from .exceptions import (  # noqa: F401
     HorovodInternalError, HostsUpdatedInterrupt, TensorValidationError,

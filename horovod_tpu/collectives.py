@@ -22,8 +22,9 @@ Semantics parity with the reference API
 * ``alltoall(tensor, splits, name)``
 * ``grouped_allreduce([tensors], ...)`` — one fused dispatch
 * duplicate in-flight names raise (tensor_queue.cc DUPLICATE_NAME_ERROR)
-* with ``HVD_TPU_CHECK_CONSISTENCY=1``, mismatched shape/dtype/op across
-  processes raise instead of deadlock (controller.cc:378-611 validation)
+* mismatched shape/dtype/op across processes raise instead of deadlock
+  (controller.cc:378-611 validation; default-on, disable with
+  ``HVD_TPU_CHECK_CONSISTENCY=0``)
 
 Ops beyond a single process require ``init()`` with a multi-process world;
 with one process they are exact local equivalents (size-1 semantics, as the
@@ -261,8 +262,8 @@ def _check_consistency(w, wm, name, shape, dtype, kind, extra=""):
 
     Allgathers a 64-bit word — (exchange sequence number << 32) | metadata
     fingerprint — across processes and raises listing mismatching processes.
-    Only runs when HVD_TPU_CHECK_CONSISTENCY is enabled and the world is
-    multi-process. Steady state skips the exchange via the ResponseCache: a
+    Default-on (HVD_TPU_CHECK_CONSISTENCY=0 disables) in multi-process
+    worlds. Steady state skips the exchange via the ResponseCache: a
     fingerprint validated once is not re-exchanged until evicted (the
     reference's cache fast path, response_cache.h:104-160).
 
@@ -455,6 +456,9 @@ def allreduce_async(tensor, average=None, name: Optional[str] = None,
         _finish(w, h)
         raise
 
+    _record_round(w, ("allreduce", name, tuple(local.shape),
+                      str(local.dtype), op.value, prescale_factor,
+                      postscale_factor))
     # Snapshot join state at submit time: a collective submitted before
     # join() must carry real data even if the dispatcher runs it after.
     joined_at_submit = w.joined
@@ -486,6 +490,10 @@ def grouped_allreduce(tensors: Sequence, average=None,
     base = name or _auto_name("grouped_allreduce")
     names = [f"{base}.{i}" for i in range(len(tensors))]
     hs = [_table(w).begin(n, "grouped_allreduce") for n in names]
+    _record_round(w, ("grouped_allreduce", base,
+                      tuple(tuple(np.shape(t)) for t in tensors),
+                      tuple(str(np.asarray(t).dtype) for t in tensors),
+                      op.value, prescale_factor, postscale_factor))
     try:
         outs = _dispatcher(w).run_sync(
             lambda: _allreduce_impl(w, list(tensors), op, prescale_factor,
@@ -522,6 +530,8 @@ def allgather_async(tensor, name: Optional[str] = None, process_set=None) -> int
     tl.start(name, "allgather")
     wm = process_set or w.world_mesh
     local = np.asarray(tensor)
+    _record_round(w, ("allgather", name, tuple(local.shape),
+                      str(local.dtype)))
 
     def dispatch():
         jax, jnp = _jax(), _jnp()
@@ -611,6 +621,8 @@ def broadcast_async(tensor, root_rank: int, name: Optional[str] = None,
         _finish(w, h)
         raise ValueError(f"root_rank {root_rank} out of range for world "
                          f"size {nproc}")
+    _record_round(w, ("broadcast", name, tuple(local.shape),
+                      str(local.dtype), root_rank))
 
     def dispatch():
         jax, jnp = _jax(), _jnp()
@@ -665,6 +677,8 @@ def alltoall(tensor, splits=None, name: Optional[str] = None, process_set=None):
     except Exception:
         _finish(w, h)
         raise
+    _record_round(w, ("alltoall", name, tuple(local.shape),
+                      str(local.dtype), tuple(splits)))
 
     def dispatch():
         jax, jnp = _jax(), _jnp()
@@ -731,7 +745,8 @@ def _finish(w, h: Handle):
 
 
 def _wrap_error(e: BaseException) -> BaseException:
-    if isinstance(e, (TensorValidationError, ValueError, TypeError)):
+    if isinstance(e, (TensorValidationError, ValueError, TypeError,
+                      HorovodInternalError)):
         return e
     return HorovodInternalError(str(e))
 
@@ -773,38 +788,126 @@ def synchronize(handle: int):
         r = h.result
         if r is not None:
             insp = w.stall_inspector
-            is_ready = getattr(r, "is_ready", None)
-            if insp is not None and callable(is_ready):
-                while not is_ready():
-                    insp.check_shutdown()
-                    _time.sleep(0.002)
-            _jax().block_until_ready(r)
+            try:
+                is_ready = getattr(r, "is_ready", None)
+                if insp is not None and callable(is_ready):
+                    while not is_ready():
+                        insp.check_shutdown()
+                        _time.sleep(0.002)
+                _jax().block_until_ready(r)
+            except Exception as e:
+                # device/runtime failures (e.g. a dead peer mid-collective)
+                # must surface as HorovodInternalError so the elastic retry
+                # loop can restore + reset (operations.cc:298-313 semantics)
+                raise _wrap_error(e) from e
         return h.result
     finally:
         _finish(w, h)
 
 
-def join(device: int = -1) -> int:
-    """Signal that this process has exhausted its data (reference Join op,
-    operations.cc:942-966, controller.cc:219-273: remaining collectives see
-    zero contributions from joined ranks).
+# ---------------------------------------------------------------------------
+# Join: uneven-data termination (reference Join op, operations.cc:942-966,
+# controller.cc:219-273). The reference's background thread lets a joined
+# rank keep negotiating one-sidedly; in the compiled SPMD plane the same
+# effect comes from a ROUND protocol:
+#
+# * join-aware training wrappers (torch DistributedOptimizer.synchronize,
+#   or user loops via join_round()) issue one tiny "round marker" allreduce
+#   per step, in which every process contributes 1 if it still has data;
+# * the collective layer records each round's submissions (name/shape/dtype)
+#   — the wire-format Request log, the descendant of the reference's
+#   negotiation messages;
+# * join() flips this process to zero-contributions and REPLAYS its last
+#   recorded round in lockstep with the still-active ranks until the round
+#   marker reports zero active processes everywhere.
+#
+# This assumes steady per-round collective sequences (true for training
+# loops, which is the reference's Join use case) instead of arbitrary
+# dynamic sets — the static-bucketing compromise documented in SURVEY §7.
+# ---------------------------------------------------------------------------
 
-    Departure from the reference, documented: the reference's background
-    thread keeps a joined rank participating in negotiation one-sidedly. In
-    the compiled SPMD eager plane there is no background negotiation, so Join
-    is cooperative: after ``join()`` this process contributes zeros to every
-    subsequent reduction but must keep driving its training loop's
-    collectives until all processes have joined (the
-    :mod:`horovod_tpu.optimizer` wrappers do this). ``join()`` itself is a
-    collective; it returns the rank that joined last, determined by
-    exchanging per-process join timestamps."""
-    import time as _time
+_JOIN_ROUND_NAME = "hvd.join.round"
+
+
+def _record_round(w, entry) -> None:
+    if entry[1].startswith(("hvd.join.", "horovod_tpu.join.")):
+        return
+    log = getattr(w, "_join_round_log", None)
+    if log is None:
+        log = w._join_round_log = []
+    log.append(entry)
+
+
+def join_round() -> int:
+    """Round marker for cooperative Join: returns how many processes still
+    have data. Training wrappers call this once per step; custom loops that
+    want Join semantics must do the same."""
     w = _world()
+    if w.world_mesh.num_procs == 1:
+        return 0 if w.joined else 1
+    me = np.zeros((1,), np.float32) if w.joined else np.ones((1,), np.float32)
+    if not w.joined:
+        w._join_active_rounds = getattr(w, "_join_active_rounds", 0) + 1
+    out = allreduce(me, op=ReduceOp.SUM, name=_JOIN_ROUND_NAME)
+    # rotate the round log: what was submitted since the last marker is one
+    # full round — the replay script for join()
+    w._join_last_round = getattr(w, "_join_round_log", [])
+    w._join_round_log = []
+    return int(round(float(np.asarray(out)[0])))
+
+
+def _replay_round(entries) -> None:
+    """Re-issue one round's collectives with zero/empty contributions (the
+    reference's zero-tensor substitution for joined ranks,
+    tensor_queue.cc GetTensorEntriesFromResponse)."""
+    for e in entries:
+        kind = e[0]
+        if kind == "allreduce":
+            _, name, shape, dtype, opv, pre, post = e
+            allreduce(np.zeros(shape, dtype), op=ReduceOp(opv), name=name,
+                      prescale_factor=pre, postscale_factor=post)
+        elif kind == "grouped_allreduce":
+            _, name, shapes, dtypes, opv, pre, post = e
+            grouped_allreduce(
+                [np.zeros(s, d) for s, d in zip(shapes, dtypes)],
+                op=ReduceOp(opv), name=name,
+                prescale_factor=pre, postscale_factor=post)
+        elif kind == "allgather":
+            _, name, shape, dtype = e
+            # zero rows: this process contributes nothing to the gather
+            allgather(np.zeros((0,) + tuple(shape[1:]), dtype), name=name)
+        elif kind == "broadcast":
+            _, name, shape, dtype, root = e
+            broadcast(np.zeros(shape, dtype), root_rank=root, name=name)
+        elif kind == "alltoall":
+            _, name, shape, dtype, splits = e
+            alltoall(np.zeros(shape, dtype), splits=splits, name=name)
+
+
+def join(device: int = -1) -> int:
+    """Block until every process has joined; this process contributes zeros
+    to all collectives issued meanwhile (reference Join semantics). Returns
+    the rank that joined last. Requires the training loop to be join-aware
+    (one ``join_round()`` marker per step — the torch DistributedOptimizer
+    does this automatically in multi-process worlds)."""
+    w = _world()
+    already = w.joined
     w.joined = True
-    # exchange (timestamp, rank); argmax timestamp = last to join
-    stamp = np.array([_time.time()], dtype=np.float64)
-    stamps = np.asarray(allgather(stamp, name="horovod_tpu.join.ts"))
-    return int(np.argmax(stamps))
+    wm = w.world_mesh
+    if wm.num_procs > 1 and not already:
+        replay = list(getattr(w, "_join_last_round", []))
+        # lockstep with active ranks: one replayed round + marker per their
+        # real round, until nobody has data
+        while True:
+            _replay_round(replay)
+            if join_round() == 0:
+                break
+    # Last to join = the process that stayed active for the most rounds
+    # (wall-clock is ambiguous: every process exits the loop in the same
+    # round). All processes reach this allgather together.
+    rounds = np.array([getattr(w, "_join_active_rounds", 0)], np.float64)
+    counts = np.asarray(allgather(rounds, name="horovod_tpu.join.ts"))
+    return int(np.argmax(counts))
 
 
 def joined() -> bool:

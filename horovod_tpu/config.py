@@ -116,8 +116,11 @@ INIT_TIMEOUT_SECONDS = _register(
 # -- Consistency checking (replaces the reference controller's per-cycle
 #    dtype/shape validation, controller.cc:378-611) --------------------------
 CHECK_CONSISTENCY = _register(
-    "CHECK_CONSISTENCY", False, _parse_bool,
-    help="Cross-process validation of name/shape/dtype for eager collectives.")
+    "CHECK_CONSISTENCY", True, _parse_bool,
+    help="Cross-process validation of name/shape/dtype for eager collectives. "
+         "Default ON (the reference validates every negotiation, "
+         "controller.cc:378-611); the ResponseCache makes the steady-state "
+         "cost one cached lookup. Set HVD_TPU_CHECK_CONSISTENCY=0 to disable.")
 
 # -- Misc -------------------------------------------------------------------
 NUM_STREAMS = _register(

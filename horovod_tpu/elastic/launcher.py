@@ -126,7 +126,10 @@ def launch_elastic(args) -> int:
         args.command, rendezvous, rdv_host, rendezvous.port, base_env,
         output_dir=args.output_filename)
 
-    driver.start(min_np, create_worker_fn)
+    # First generation targets the requested -np (reference: launch_gloo_
+    # elastic starts at settings.num_proc); later resumes shrink/grow within
+    # [min_np, max_np].
+    driver.start(args.np or min_np, create_worker_fn)
     results = driver.get_results()
     driver.stop()
 

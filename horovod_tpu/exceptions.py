@@ -50,9 +50,12 @@ class NotInitializedError(RuntimeError):
             f"{what} has not been initialized; call horovod_tpu.init() first.")
 
 
-class StallError(RuntimeError):
+class StallError(HorovodInternalError):
     """Raised (optionally) by the stall inspector after the shutdown deadline.
 
-    Reference: horovod/common/stall_inspector.cc:31-90 with
-    HOROVOD_STALL_SHUTDOWN_TIME_SECONDS.
+    Subclasses :class:`HorovodInternalError` so the elastic retry loop
+    treats a stalled collective (usually a dead or wedged peer) as a
+    recoverable fault: restore committed state and re-initialize
+    (reference: stall shutdown aborts the job, stall_inspector.cc:31-90;
+    elastic recovery then restarts it — here the two compose directly).
     """

@@ -76,7 +76,7 @@ def get_host_assignments(hosts: List[HostInfo], min_np: int,
         raise ValueError(
             f"requested at least {min_np} processes but hosts "
             f"{[h.hostname for h in hosts]} provide only {total} slots")
-    size = min(total, max_np) if max_np else min_np
+    size = min(total, max_np) if max_np else total
 
     # host-major rank assignment
     placements: List[Tuple[str, int]] = []       # (hostname, local_rank)

@@ -1,0 +1,235 @@
+"""Spark ML estimator machinery shared by KerasEstimator / TorchEstimator.
+
+Reference: /root/reference/horovod/spark/common/params.py (shared Param
+plumbing), spark/common/estimator.py, and the per-framework estimators
+(spark/keras/estimator.py:105-379, spark/torch/estimator.py:84-304). The
+flow is identical:
+
+  fit(df) -> materialize the DataFrame as Parquet through the Store
+          -> run a distributed training function (one worker per Spark
+             executor via horovod_tpu.spark.run, or in-process when no
+             Spark session exists)
+          -> return a Model transformer carrying the trained weights.
+
+PySpark is optional (import-gated like the whole package): with a live
+SparkSession the estimator is a real Spark ML pipeline stage (Estimator /
+Model subclasses, DataFrame in/out); without it the same estimator trains
+from pandas DataFrames through the identical Store/Parquet path, so the
+data pipeline is exercised end-to-end either way.
+"""
+
+import os
+import time
+import uuid
+from typing import Any, Callable, Dict, List, Optional
+
+import numpy as np
+
+from .store import LocalStore, Store, read_parquet_shard, write_parquet
+
+
+def _pyspark():
+    try:
+        import pyspark
+        return pyspark
+    except ImportError:
+        return None
+
+
+def _is_spark_df(df) -> bool:
+    ps = _pyspark()
+    if ps is None:
+        return False
+    from pyspark.sql import DataFrame
+    return isinstance(df, DataFrame)
+
+
+class EstimatorParams:
+    """Getter/setter param plumbing (reference spark/common/params.py).
+
+    Every param ``foo`` gets ``setFoo/getFoo`` through ``_param_names`` —
+    the Spark ML calling convention without requiring pyspark at import.
+    """
+
+    _param_names: List[str] = [
+        "model", "optimizer", "loss", "metrics", "feature_cols",
+        "label_cols", "output_cols", "batch_size", "epochs",
+        "validation", "num_proc", "store", "run_id", "verbose", "shuffle",
+        "random_seed",
+    ]
+
+    def __init__(self, **kwargs):
+        self.model = None
+        self.optimizer = None
+        self.loss = None
+        self.metrics = []
+        self.feature_cols = ["features"]
+        self.label_cols = ["label"]
+        self.output_cols: Optional[List[str]] = None
+        self.batch_size = 32
+        self.epochs = 1
+        self.validation: Optional[float] = None
+        self.num_proc: Optional[int] = None
+        self.store: Optional[Store] = None
+        self.run_id: Optional[str] = None
+        self.verbose = 0
+        self.shuffle = True
+        self.random_seed = 0
+        for k, v in kwargs.items():
+            if k not in self._param_names:
+                raise TypeError(f"unknown estimator param {k!r}")
+            setattr(self, k, v)
+
+    def __getattr__(self, name):
+        # setFooBar / getFooBar -> foo_bar  (Spark ML convention)
+        for prefix in ("set", "get"):
+            if name.startswith(prefix) and len(name) > 3:
+                snake = "".join(
+                    "_" + c.lower() if c.isupper() else c
+                    for c in name[3:]).lstrip("_")
+                if snake in self._param_names:
+                    if prefix == "set":
+                        def setter(value, _n=snake):
+                            setattr(self, _n, value)
+                            return self
+                        return setter
+                    return lambda _n=snake: getattr(self, _n)
+        raise AttributeError(name)
+
+
+class HorovodEstimator(EstimatorParams):
+    """Common fit() machinery; subclasses provide the framework specifics
+    (serialize model, remote train fn, build the Model transformer)."""
+
+    def _resolve_store(self) -> Store:
+        if self.store is None:
+            import tempfile
+            self.store = LocalStore(
+                tempfile.mkdtemp(prefix="hvd_tpu_store_"))
+        elif isinstance(self.store, str):
+            self.store = Store.create(self.store)
+        return self.store
+
+    def _resolve_run_id(self) -> str:
+        if not self.run_id:
+            self.run_id = f"run_{int(time.time())}_{uuid.uuid4().hex[:8]}"
+        return self.run_id
+
+    # -- data materialization ------------------------------------------------
+    def _materialize(self, df) -> str:
+        """DataFrame -> Parquet under the store; returns the dataset path."""
+        store = self._resolve_store()
+        path = store.get_train_data_path(self._resolve_run_id())
+        cols = list(self.feature_cols) + list(self.label_cols)
+        if _is_spark_df(df):
+            df.select(cols).write.mode("overwrite").parquet(path)
+        else:
+            # pandas or dict-of-arrays
+            if hasattr(df, "to_dict"):
+                data = {c: np.stack(df[c].to_numpy()) if df[c].dtype == object
+                        else df[c].to_numpy() for c in cols}
+            else:
+                data = {c: np.asarray(df[c]) for c in cols}
+            write_parquet(path, data)
+        return path
+
+    # -- training dispatch ---------------------------------------------------
+    def _run_distributed(self, train_fn: Callable, train_path: str):
+        """Run ``train_fn(rank, size, train_path)`` on every worker; returns
+        rank-0's result. Uses Spark executors when a session is live,
+        otherwise the current process (single worker or an existing
+        horovod_tpu world)."""
+        ps = _pyspark()
+        if ps is not None:
+            from pyspark.sql import SparkSession
+            if SparkSession.getActiveSession() is not None:
+                from . import run as spark_run
+                results = spark_run(
+                    _SparkTrainTask(train_fn, train_path),
+                    num_proc=self.num_proc, verbose=bool(self.verbose))
+                return results[0]
+        from .. import basics
+        if basics.is_initialized():
+            rank, size = basics.rank(), basics.size()
+        else:
+            rank, size = 0, 1
+        return train_fn(rank, size, train_path)
+
+    def fit(self, df):
+        """Materialize ``df`` and train; returns the fitted Model
+        transformer (reference: estimator.py fit / _fit_on_prepared_data)."""
+        train_path = self._materialize(df)
+        train_fn = self._make_train_fn()
+        result = self._run_distributed(train_fn, train_path)
+        return self._make_model(result)
+
+    # -- subclass hooks ------------------------------------------------------
+    def _make_train_fn(self) -> Callable:
+        raise NotImplementedError
+
+    def _make_model(self, train_result):
+        raise NotImplementedError
+
+
+class _SparkTrainTask:
+    """Picklable wrapper so the train fn ships to Spark executors."""
+
+    def __init__(self, fn, train_path):
+        self.fn = fn
+        self.train_path = train_path
+
+    def __call__(self):
+        from .. import basics
+        basics.init()
+        try:
+            return self.fn(basics.rank(), basics.size(), self.train_path)
+        finally:
+            basics.shutdown()
+
+
+class HorovodModel:
+    """Base transformer returned by fit() (reference: spark/common —
+    KerasModel/TorchModel). ``transform`` appends prediction columns."""
+
+    def __init__(self, feature_cols: List[str], label_cols: List[str],
+                 output_cols: Optional[List[str]] = None):
+        self.feature_cols = list(feature_cols)
+        self.label_cols = list(label_cols)
+        self.output_cols = list(output_cols) if output_cols else [
+            c + "__output" for c in self.label_cols]
+
+    def _predict(self, features: np.ndarray) -> np.ndarray:
+        raise NotImplementedError
+
+    def _stack_features(self, df, rows=None):
+        cols = []
+        for c in self.feature_cols:
+            col = df[c]
+            arr = (np.stack(col.to_numpy()) if hasattr(col, "to_numpy")
+                   else np.asarray(col))
+            if arr.dtype == object:
+                arr = np.stack(arr)
+            cols.append(arr.reshape(len(arr), -1))
+        return np.concatenate(cols, axis=1) if len(cols) > 1 else cols[0]
+
+    def transform(self, df):
+        if _is_spark_df(df):
+            import pandas as pd
+            from pyspark.sql.functions import pandas_udf
+
+            model = self
+
+            @pandas_udf("array<double>")
+            def predict_udf(*feature_series):
+                feats = np.concatenate(
+                    [np.stack(s.to_numpy()).reshape(len(s), -1)
+                     for s in feature_series], axis=1)
+                preds = model._predict(feats)
+                return pd.Series(list(np.asarray(preds, dtype=np.float64)))
+
+            return df.withColumn(self.output_cols[0],
+                                 predict_udf(*self.feature_cols))
+        out = df.copy()
+        preds = np.asarray(self._predict(self._stack_features(df)))
+        out[self.output_cols[0]] = list(preds)
+        return out

@@ -1,0 +1,122 @@
+"""KerasEstimator: Spark ML pipeline stage training a Keras model through
+the horovod_tpu collective plane.
+
+Reference: /root/reference/horovod/spark/keras/estimator.py:105-379 —
+serialize the compiled model on the driver, materialize the DataFrame as
+Parquet via the Store, train one worker per executor (DistributedOptimizer
++ initial broadcast), return a ``KerasModel`` transformer carrying the
+trained weights.
+"""
+
+from typing import List, Optional
+
+import numpy as np
+
+from ..estimator import HorovodEstimator, HorovodModel
+from ..store import read_parquet_shard
+
+
+def _serialize_keras(model):
+    import keras
+    return {"config": model.to_json(),
+            "weights": [np.array(w) for w in model.get_weights()]}
+
+
+def _deserialize_keras(blob):
+    import keras
+    model = keras.models.model_from_json(blob["config"])
+    model.set_weights(blob["weights"])
+    return model
+
+
+class KerasEstimator(HorovodEstimator):
+    """Usage (reference recipe)::
+
+        est = KerasEstimator(model=model, optimizer="sgd", loss="mse",
+                             feature_cols=["features"], label_cols=["y"],
+                             batch_size=32, epochs=4, store=store)
+        keras_model = est.fit(df)            # Spark or pandas DataFrame
+        pred_df = keras_model.transform(df)
+    """
+
+    def _make_train_fn(self):
+        blob = _serialize_keras(self.model)
+        optimizer = self.optimizer or "sgd"
+        loss = self.loss or "mse"
+        metrics = list(self.metrics or [])
+        feature_cols = list(self.feature_cols)
+        label_cols = list(self.label_cols)
+        batch_size, epochs = int(self.batch_size), int(self.epochs)
+        shuffle, seed = bool(self.shuffle), int(self.random_seed)
+        verbose = int(self.verbose)
+
+        def train_fn(rank: int, size: int, train_path: str):
+            import keras
+
+            from ... import tensorflow as hvd_tf
+
+            model = _deserialize_keras(blob)
+            if size > 1:
+                # initial weight broadcast (reference:
+                # BroadcastGlobalVariablesCallback role)
+                ws = model.get_weights()
+                ws = [np.asarray(hvd_tf.broadcast(
+                    _np_tensor(w), 0, name=f"keras_est.w.{i}"))
+                    for i, w in enumerate(ws)]
+                model.set_weights(ws)
+
+            cols = read_parquet_shard(
+                train_path, feature_cols + label_cols, rank, size)
+            x = _stack(cols[:len(feature_cols)])
+            y = _stack(cols[len(feature_cols):])
+
+            opt = (keras.optimizers.get(optimizer)
+                   if isinstance(optimizer, str) else optimizer)
+            if size > 1:
+                opt = hvd_tf.DistributedOptimizer(opt)
+            model.compile(optimizer=opt, loss=loss, metrics=metrics)
+            history = model.fit(x, y, batch_size=batch_size, epochs=epochs,
+                                shuffle=shuffle, verbose=verbose)
+            return {"weights": [np.array(w) for w in model.get_weights()],
+                    "history": {k: [float(v) for v in vs]
+                                for k, vs in history.history.items()}}
+
+        def _np_tensor(w):
+            import tensorflow as tf
+            return tf.convert_to_tensor(np.asarray(w))
+
+        def _stack(arrays):
+            out = [a.reshape(len(a), -1) if a.ndim > 1 else a
+                   for a in (np.asarray(a) for a in arrays)]
+            if len(out) == 1:
+                a = out[0]
+                return a
+            return np.concatenate(
+                [a.reshape(len(a), -1) for a in out], axis=1)
+
+        return train_fn
+
+    def _make_model(self, train_result):
+        model = _deserialize_keras(_serialize_keras(self.model))
+        model.set_weights(train_result["weights"])
+        return KerasModel(model, self.feature_cols, self.label_cols,
+                          self.output_cols,
+                          history=train_result.get("history"))
+
+
+class KerasModel(HorovodModel):
+    """Transformer carrying trained Keras weights (reference:
+    spark/keras/estimator.py KerasModel)."""
+
+    def __init__(self, model, feature_cols: List[str],
+                 label_cols: List[str],
+                 output_cols: Optional[List[str]] = None, history=None):
+        super().__init__(feature_cols, label_cols, output_cols)
+        self.model = model
+        self.history = history or {}
+
+    def getModel(self):
+        return self.model
+
+    def _predict(self, features: np.ndarray) -> np.ndarray:
+        return np.asarray(self.model.predict(features, verbose=0))

@@ -1,0 +1,161 @@
+"""Store abstraction for estimator data/checkpoint placement.
+
+Reference: /root/reference/horovod/spark/common/store.py — a ``Store``
+resolves run-scoped paths for intermediate training data (Parquet),
+checkpoints, and logs, with filesystem-specific subclasses (LocalStore,
+HDFSStore). Here the local filesystem variant is fully implemented on
+pyarrow (the image's Parquet engine); remote stores (HDFS/S3/GCS) follow
+the same interface and are created through :meth:`Store.create`, which
+raises a clear error for schemes without a backend in this environment.
+
+The Parquet intermediate format is the contract that lets Spark executors
+(or any worker) stream train/val shards without the driver in the loop —
+the role Petastorm plays in the reference (spark/keras/estimator.py:105+).
+"""
+
+import os
+import shutil
+from typing import List, Optional
+
+
+class Store:
+    """Resolves run-scoped storage paths (reference store.py Store)."""
+
+    def get_train_data_path(self, run_id: str) -> str:
+        raise NotImplementedError
+
+    def get_val_data_path(self, run_id: str) -> str:
+        raise NotImplementedError
+
+    def get_checkpoint_path(self, run_id: str) -> str:
+        raise NotImplementedError
+
+    def get_logs_path(self, run_id: str) -> str:
+        raise NotImplementedError
+
+    def exists(self, path: str) -> bool:
+        raise NotImplementedError
+
+    def sync_fn(self, run_id: str):
+        """Returns a callable that persists a local working dir into the
+        store's checkpoint location (reference: store.py sync_fn)."""
+        raise NotImplementedError
+
+    @staticmethod
+    def create(prefix_path: str, *args, **kwargs) -> "Store":
+        if "://" in prefix_path and not prefix_path.startswith("file://"):
+            scheme = prefix_path.split("://", 1)[0]
+            raise ValueError(
+                f"no store backend for scheme {scheme!r} in this "
+                f"environment; use a local path (LocalStore)")
+        return LocalStore(prefix_path.removeprefix("file://"),
+                          *args, **kwargs)
+
+
+class FilesystemStore(Store):
+    """Shared path logic for filesystem-like stores."""
+
+    def __init__(self, prefix_path: str,
+                 train_path: Optional[str] = None,
+                 val_path: Optional[str] = None,
+                 checkpoint_path: Optional[str] = None):
+        self.prefix_path = prefix_path
+        self._train_path = train_path
+        self._val_path = val_path
+        self._checkpoint_path = checkpoint_path
+
+    def _run_path(self, base: Optional[str], run_id: str, leaf: str) -> str:
+        if base:
+            return os.path.join(base, run_id)
+        return os.path.join(self.prefix_path, "runs", run_id, leaf)
+
+    def get_train_data_path(self, run_id: str = "") -> str:
+        return self._run_path(self._train_path, run_id, "train_data")
+
+    def get_val_data_path(self, run_id: str = "") -> str:
+        return self._run_path(self._val_path, run_id, "val_data")
+
+    def get_checkpoint_path(self, run_id: str = "") -> str:
+        return self._run_path(self._checkpoint_path, run_id, "checkpoints")
+
+    def get_logs_path(self, run_id: str = "") -> str:
+        return self._run_path(None, run_id, "logs")
+
+
+class LocalStore(FilesystemStore):
+    """Local-filesystem store (reference store.py LocalStore)."""
+
+    def exists(self, path: str) -> bool:
+        return os.path.exists(path)
+
+    def makedirs(self, path: str) -> None:
+        os.makedirs(path, exist_ok=True)
+
+    def sync_fn(self, run_id: str):
+        target = self.get_checkpoint_path(run_id)
+
+        def sync(local_dir: str) -> None:
+            os.makedirs(target, exist_ok=True)
+            for name in os.listdir(local_dir):
+                src = os.path.join(local_dir, name)
+                dst = os.path.join(target, name)
+                if os.path.isdir(src):
+                    shutil.copytree(src, dst, dirs_exist_ok=True)
+                else:
+                    shutil.copy2(src, dst)
+        return sync
+
+
+# ---------------------------------------------------------------------------
+# Parquet IO helpers (the Petastorm-equivalent data path)
+# ---------------------------------------------------------------------------
+
+def write_parquet(path: str, columns: dict, row_group_rows: int = 4096,
+                  partitions: int = 1) -> None:
+    """Write named numpy columns as one or more Parquet files under
+    ``path`` (a directory, like a Spark parquet dataset)."""
+    import numpy as np
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    os.makedirs(path, exist_ok=True)
+    n = len(next(iter(columns.values())))
+    per = (n + partitions - 1) // partitions
+    for p in range(partitions):
+        sl = slice(p * per, min((p + 1) * per, n))
+        if sl.start >= n:
+            break
+        arrays, names = [], []
+        for name, col in columns.items():
+            col = np.asarray(col)[sl]
+            if col.ndim > 1:   # fixed-size vectors become list columns
+                arrays.append(pa.array(list(col)))
+            else:
+                arrays.append(pa.array(col))
+            names.append(name)
+        pq.write_table(pa.Table.from_arrays(arrays, names=names),
+                       os.path.join(path, f"part-{p:05d}.parquet"),
+                       row_group_size=row_group_rows)
+
+
+def read_parquet_shard(path: str, columns: List[str], rank: int = 0,
+                       size: int = 1):
+    """Read this worker's shard (rows ``rank::size``) of a Parquet dataset
+    directory into numpy arrays, one per requested column."""
+    import numpy as np
+    import pyarrow.parquet as pq
+
+    files = sorted(
+        os.path.join(path, f) for f in os.listdir(path)
+        if f.endswith(".parquet"))
+    if not files:
+        raise FileNotFoundError(f"no parquet files under {path}")
+    tables = [pq.read_table(f, columns=columns) for f in files]
+    import pyarrow as pa
+    table = pa.concat_tables(tables)
+    out = []
+    for c in columns:
+        col = table.column(c).to_pylist()
+        arr = np.asarray(col)
+        out.append(arr[rank::size])
+    return out

@@ -171,3 +171,96 @@ def DistributedOptimizer(optimizer, op=Average, name_prefix: str = "opt"):
 
     optimizer.apply_gradients = apply_gradients
     return optimizer
+
+
+def DistributedDeltaOptimizer(optimizer, backward_passes_per_step: int = 1,
+                              name_prefix: str = "adasum_delta"):
+    """Adasum *delta* optimizer (reference: tensorflow/__init__.py:303-397
+    _DistributedAdasumOptimizer): the inner optimizer updates variables
+    locally; on each communication step the scale-invariant Adasum rule
+    combines the accumulated model *deltas* (var - start) across processes
+    and every variable is set to start + adasum(delta).
+
+    The reference builds this as a TF1 graph optimizer with ``delta_start``
+    slots and tf.cond step gating; here the same algorithm runs eagerly
+    (TF2/Keras-3), with the start snapshots held as non-trainable variables.
+    """
+    tf = _tf()
+    state = {"starts": {}, "step": 0}
+    orig_apply = type(optimizer).apply_gradients
+
+    def apply_gradients(grads_and_vars, *args, **kwargs):
+        gv = list(grads_and_vars)
+        vars_ = [v for _, v in gv]
+        # initialize start snapshots on the first step (delta_start slots)
+        for v in vars_:
+            if v.ref() not in state["starts"]:
+                state["starts"][v.ref()] = tf.Variable(v, trainable=False)
+        result = orig_apply(optimizer, gv, *args, **kwargs)
+        state["step"] += 1
+        if state["step"] % backward_passes_per_step == 0:
+            deltas = [(v - state["starts"][v.ref()]).numpy() for v in vars_]
+            reduced = _c.grouped_allreduce(
+                deltas, op=_c.Adasum,
+                name=f"{name_prefix}.{state['step']}")
+            for v, rd in zip(vars_, reduced):
+                start = state["starts"][v.ref()]
+                start.assign_add(np.asarray(rd))
+                v.assign(start)
+        return result
+
+    optimizer.apply_gradients = apply_gradients
+    return optimizer
+
+
+class BroadcastGlobalVariablesHook:
+    """TF1 ``SessionRunHook`` that broadcasts all global variables from the
+    root rank after session creation (reference:
+    tensorflow/__init__.py:187-220). Construct lazily on top of
+    ``tf.compat.v1.train.SessionRunHook`` so graph-mode users get consistent
+    initialization; in TF2 eager code use :func:`broadcast_variables`.
+
+    The graph side only carries placeholder-fed assigns; the broadcast itself
+    runs through the eager XLA collective plane on host values — the same
+    host-staging contract as the rest of this module.
+    """
+
+    def __new__(cls, root_rank: int = 0, device: str = ""):
+        tf = _tf()
+
+        class _Hook(tf.compat.v1.train.SessionRunHook):
+            def __init__(self):
+                self.root_rank = root_rank
+                self._vars = None
+                self._phs = None
+                self._assign = None
+
+            def begin(self):
+                self._vars = tf.compat.v1.global_variables()
+                self._phs = [
+                    tf.compat.v1.placeholder(v.dtype.base_dtype, v.shape)
+                    for v in self._vars]
+                self._assign = tf.group(*[
+                    tf.compat.v1.assign(v, p)
+                    for v, p in zip(self._vars, self._phs)])
+
+            def after_create_session(self, session, coord):
+                vals = session.run(self._vars)
+                outs = [np.asarray(_c.broadcast(
+                    np.asarray(val), root_rank=self.root_rank,
+                    name=f"bcast.gv.{i}"))
+                    for i, val in enumerate(vals)]
+                session.run(self._assign,
+                            feed_dict=dict(zip(self._phs, outs)))
+
+        return _Hook()
+
+
+def __getattr__(name):  # PEP 562: keep tensorflow import deferred
+    if name == "elastic":
+        import importlib
+        return importlib.import_module(".elastic", __name__)
+    if name == "Compression":
+        from ..compression import Compression
+        return Compression
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

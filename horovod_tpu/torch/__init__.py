@@ -35,7 +35,7 @@ from ..basics import (  # noqa: F401  (reference API parity re-exports)
     cross_rank, cross_size,
 )
 from ..collectives import (  # noqa: F401
-    Average, Sum, Adasum, poll, join,
+    Average, Sum, Adasum, poll, join, join_round,
 )
 from ..compression import Compression  # noqa: F401
 
@@ -281,6 +281,10 @@ class _DistributedOptimizer:
     # torch optimizer protocol ----------------------------------------------
     def synchronize(self):
         import torch
+        if _basics.size() > 1:
+            # round marker for cooperative Join (uneven data): joined ranks
+            # pair this with their replay loop (collectives.join_round)
+            _c.join_round()
         for p, h in list(self._handles.items()):
             out = _synchronize_handle(h)
             out = self._compression.decompress(out, self._ctxs.pop(p, None))

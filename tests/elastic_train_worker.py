@@ -1,0 +1,79 @@
+"""Elastic end-to-end training worker, launched by horovodrun-tpu under the
+scheduled-discovery integration harness (tests/test_elastic_e2e.py).
+
+Mirrors the reference's test/integration/data training scripts driven by
+elastic_common.py:41-246: trains a fixed number of epochs with per-epoch
+commits, logs every epoch with its (rank, size) so the harness can assert
+which generation ran it, and can kill itself once at a configured
+(rank, epoch) to exercise failure recovery + host blacklisting.
+
+Env contract from the harness:
+  ELASTIC_TEST_DIR     shared scratch dir (logs + kill marker)
+  ELASTIC_TEST_EPOCHS  total epochs to run
+  ELASTIC_TEST_KILL_RANK / ELASTIC_TEST_KILL_EPOCH  optional one-shot crash
+"""
+
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.pop("XLA_FLAGS", None)
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+import horovod_tpu as hvd  # noqa: E402
+
+TEST_DIR = os.environ["ELASTIC_TEST_DIR"]
+EPOCHS = int(os.environ.get("ELASTIC_TEST_EPOCHS", "4"))
+KILL_RANK = os.environ.get("ELASTIC_TEST_KILL_RANK")
+KILL_EPOCH = int(os.environ.get("ELASTIC_TEST_KILL_EPOCH", "-1"))
+KILL_MARKER = os.path.join(TEST_DIR, "killed.marker")
+LOG_PATH = os.path.join(TEST_DIR, "events.log")
+
+
+def log_event(msg: str) -> None:
+    with open(LOG_PATH, "a") as f:
+        f.write(msg + "\n")
+        f.flush()
+
+
+def main():
+    hvd.init()
+    state = hvd.elastic.ObjectState(epoch=0, total=0.0)
+
+    @hvd.elastic.run
+    def train(state):
+        while state.epoch < EPOCHS:
+            epoch_sum = 0.0
+            for b in range(2):
+                out = hvd.allreduce(
+                    np.ones(4, np.float32), op=hvd.Sum,
+                    name=f"grad.{b}")
+                epoch_sum = float(np.asarray(out)[0])
+                if (KILL_RANK is not None
+                        and hvd.rank() == int(KILL_RANK)
+                        and state.epoch == KILL_EPOCH
+                        and not os.path.exists(KILL_MARKER)):
+                    open(KILL_MARKER, "w").close()
+                    log_event(f"killed rank={hvd.rank()} "
+                              f"epoch={state.epoch}")
+                    sys.stdout.flush()
+                    os._exit(17)
+            state.total += epoch_sum
+            state.epoch += 1
+            log_event(f"epoch={state.epoch} rank={hvd.rank()} "
+                      f"size={hvd.size()}")
+            state.commit()
+
+    train(state)
+    log_event(f"done rank={hvd.rank()} size={hvd.size()} "
+              f"epochs={state.epoch} total={state.total}")
+    hvd.shutdown()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,66 @@
+"""Worker for the multi-process uneven-data Join integration test.
+
+Mirrors the reference's torch join tests (test_torch.py uneven-batch
+coverage of operations.cc:942-966): each rank trains a different number of
+batches through DistributedOptimizer, then calls join(); ranks that finish
+early contribute zeros while the others keep training, and join() returns
+the rank that trained longest.
+"""
+
+import os
+import sys
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ.pop("XLA_FLAGS", None)
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+import torch  # noqa: E402
+
+import horovod_tpu.torch as hvd  # noqa: E402
+
+
+def main():
+    hvd.init()
+    rank, size = hvd.rank(), hvd.size()
+
+    torch.manual_seed(1234)  # identical init on every rank
+    model = torch.nn.Linear(4, 2, bias=False)
+    opt = hvd.DistributedOptimizer(
+        torch.optim.SGD(model.parameters(), lr=0.05),
+        named_parameters=model.named_parameters())
+
+    # uneven data: rank r gets 2 + r batches
+    num_batches = 2 + rank
+    gen = torch.Generator().manual_seed(7)  # same data stream everywhere
+    for _ in range(num_batches):
+        x = torch.randn(8, 4, generator=gen)
+        opt.zero_grad()
+        loss = (model(x) ** 2).mean()
+        loss.backward()
+        opt.step()
+
+    last = hvd.join()
+    assert last == size - 1, f"rank {rank}: expected last joiner "\
+        f"{size - 1}, got {last}"
+
+    # joined ranks stopped stepping, so re-seed everyone from the rank that
+    # trained longest (the reference post-join recipe) and verify all equal
+    hvd.broadcast_parameters(model.state_dict(), root_rank=last)
+    w = model.weight.detach().numpy().copy()
+    g = np.asarray(hvd.allgather(torch.from_numpy(w[None]),
+                                 name="join.final_w").numpy())
+    for r in range(size):
+        np.testing.assert_allclose(
+            g[r], g[0], rtol=1e-5, atol=1e-6,
+            err_msg=f"rank {rank}: weights diverged across ranks")
+
+    assert np.isfinite(w).all()
+    print(f"join worker {rank} OK", flush=True)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,146 @@
+"""Elastic end-to-end integration tests on localhost.
+
+The TPU-shaped port of the reference's scheduled-discovery harness
+(/root/reference/test/integration/elastic_common.py:41-246): a temporary
+host-discovery script whose output the test mutates mid-run, real
+``horovodrun-tpu`` elastic launches, a worker killed mid-epoch, and
+assertions that training completes with the re-exec'd generation and
+committed state restored. "Hosts" are localhost aliases (localhost /
+127.0.0.1), each with one slot, so multi-host driver logic (blacklisting,
+stable assignment) runs on a single machine.
+
+These cover the worker re-exec reset path (horovod_tpu/elastic/run.py
+reset/os.execve) that the unit-level driver tests cannot reach.
+"""
+
+import os
+import re
+import stat
+import subprocess
+import sys
+import tempfile
+import time
+
+import pytest
+
+WORKER = os.path.join(os.path.dirname(__file__), "elastic_train_worker.py")
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(WORKER)))
+
+
+def _write_discovery_script(path: str, hosts_file: str) -> None:
+    with open(path, "w") as f:
+        f.write(f"#!/bin/sh\ncat {hosts_file}\n")
+    os.chmod(path, os.stat(path).st_mode | stat.S_IEXEC)
+
+
+def _launch(test_dir: str, hosts: str, extra_env=None, np_=2, min_np=1,
+            epochs=4, timeout=300, extra_args=()):
+    hosts_file = os.path.join(test_dir, "hosts.txt")
+    with open(hosts_file, "w") as f:
+        f.write(hosts + "\n")
+    script = os.path.join(test_dir, "discover.sh")
+    _write_discovery_script(script, hosts_file)
+
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    env.update({
+        "PYTHONPATH": REPO + os.pathsep + env.get("PYTHONPATH", ""),
+        "JAX_PLATFORMS": "cpu",
+        "ELASTIC_TEST_DIR": test_dir,
+        "ELASTIC_TEST_EPOCHS": str(epochs),
+    })
+    env.update(extra_env or {})
+    cmd = [sys.executable, "-m", "horovod_tpu.runner",
+           "-np", str(np_), "--min-np", str(min_np),
+           "--host-discovery-script", script,
+           "--slots", "1",
+           "--stall-check-warning-time-seconds", "5",
+           "--stall-check-shutdown-time-seconds", "15",
+           *extra_args,
+           sys.executable, WORKER]
+    proc = subprocess.Popen(cmd, env=env, stdout=subprocess.PIPE,
+                            stderr=subprocess.STDOUT, cwd=test_dir)
+    return proc, hosts_file
+
+
+def _finish(proc, timeout=300):
+    try:
+        out, _ = proc.communicate(timeout=timeout)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+        out, _ = proc.communicate()
+        raise AssertionError(
+            "elastic launch timed out:\n" + out.decode(errors="replace")[-6000:])
+    return proc.returncode, out.decode(errors="replace")
+
+
+def _events(test_dir):
+    path = os.path.join(test_dir, "events.log")
+    if not os.path.exists(path):
+        return []
+    with open(path) as f:
+        return [l.strip() for l in f if l.strip()]
+
+
+@pytest.mark.integration
+def test_elastic_fault_tolerance_rank_failure():
+    """Kill rank 1 mid-epoch: the driver records the failure, blacklists its
+    host, and the surviving worker restores committed state and finishes all
+    epochs (reference scenario: elastic_common.py single-rank failure)."""
+    with tempfile.TemporaryDirectory() as td:
+        proc, _ = _launch(
+            td, "localhost:1\n127.0.0.1:1",
+            extra_env={"ELASTIC_TEST_KILL_RANK": "1",
+                       "ELASTIC_TEST_KILL_EPOCH": "1"},
+            np_=2, min_np=1, epochs=4)
+        code, out = _finish(proc)
+        events = _events(td)
+        assert code == 0, f"launcher exited {code}:\n{out[-6000:]}\n" \
+                          f"events: {events}"
+        assert any(e.startswith("killed rank=1 epoch=1") for e in events), \
+            events
+        done = [e for e in events if e.startswith("done ")]
+        assert done, events
+        # the survivor finished every epoch; after the blacklist the world
+        # is size 1
+        m = re.search(r"done rank=0 size=(\d+) epochs=(\d+)", done[0])
+        assert m, done
+        assert int(m.group(2)) == 4
+        assert int(m.group(1)) == 1
+        # epochs 2..4 ran in the shrunken generation => committed state
+        # (epoch counter) survived the re-exec reset
+        later = [e for e in events if re.match(r"epoch=[234] rank=0 size=1", e)]
+        assert len(later) >= 3, events
+
+
+@pytest.mark.integration
+def test_elastic_scale_up_mid_training():
+    """Start with one host; add a second mid-run. Workers interrupt at the
+    next commit, re-exec into the bigger generation, and later epochs run
+    with size 2 (reference scenario: hosts added)."""
+    with tempfile.TemporaryDirectory() as td:
+        proc, hosts_file = _launch(
+            td, "localhost:1", np_=1, min_np=1, epochs=6,
+            extra_args=("--max-np", "2"))
+        # wait for training to actually start, then add a host
+        deadline = time.time() + 120
+        while time.time() < deadline:
+            if any(e.startswith("epoch=1 ") for e in _events(td)):
+                break
+            time.sleep(0.5)
+        else:
+            proc.kill()
+            raise AssertionError(f"no progress: {_events(td)}")
+        with open(hosts_file, "w") as f:
+            f.write("localhost:1\n127.0.0.1:1\n")
+        code, out = _finish(proc)
+        events = _events(td)
+        assert code == 0, f"launcher exited {code}:\n{out[-6000:]}\n" \
+                          f"events: {events}"
+        done = [e for e in events if e.startswith("done rank=0")]
+        assert done, events
+        m = re.search(r"done rank=0 size=(\d+) epochs=(\d+)", done[0])
+        assert int(m.group(2)) == 6, events
+        # at least one epoch ran in the grown generation
+        assert any(re.match(r"epoch=\d+ rank=\d+ size=2", e)
+                   for e in events), events

@@ -62,3 +62,42 @@ def test_multiprocess_collectives(n):
     for i, (c, o) in enumerate(zip(codes, outs)):
         assert c == 0, f"worker {i} failed (exit {c}):\n{o[-4000:]}"
         assert f"worker {i} OK" in o
+
+
+JOIN_WORKER = os.path.join(os.path.dirname(__file__), "join_worker.py")
+
+
+@pytest.mark.integration
+@pytest.mark.parametrize("n", [2, 3])
+def test_multiprocess_join_uneven_data(n):
+    """Uneven batch counts + join() (reference: test_torch.py join tests,
+    operations.cc:942-966). Rank r trains 2+r batches; early finishers
+    contribute zeros via the round-replay protocol and join() reports the
+    longest-running rank."""
+    port = _free_port()
+    procs = []
+    for pid in range(n):
+        env = dict(os.environ)
+        env.pop("XLA_FLAGS", None)
+        repo_root = os.path.dirname(
+            os.path.dirname(os.path.abspath(JOIN_WORKER)))
+        env.update({
+            "PYTHONPATH": repo_root + os.pathsep + env.get("PYTHONPATH", ""),
+            "JAX_PLATFORMS": "cpu",
+            "HVD_TPU_COORDINATOR_ADDR": f"127.0.0.1:{port}",
+            "HVD_TPU_SIZE": str(n),
+            "HVD_TPU_RANK": str(pid),
+        })
+        procs.append(subprocess.Popen(
+            [sys.executable, JOIN_WORKER], env=env,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT))
+    for i, p in enumerate(procs):
+        try:
+            out, _ = p.communicate(timeout=180)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            raise
+        o = out.decode(errors="replace")
+        assert p.returncode == 0, f"worker {i} failed:\n{o[-4000:]}"
+        assert f"join worker {i} OK" in o
