@@ -123,12 +123,23 @@ def init(process_sets: Optional[Sequence[Sequence[int]]] = None,
 
         jax = _jax()
         if addr and n and n > 1:
+            # Controlled failure-detection latency: under an elastic launch
+            # a dead peer must surface quickly so the driver's recovery
+            # path (respawn + state restore) wins over a stalled job; a
+            # non-elastic job has no recovery path and keeps the tolerant
+            # jax default instead.
+            heartbeat = cfg.get(_config.HEARTBEAT_TIMEOUT_SECONDS)
+            if heartbeat < 0:
+                heartbeat = 10.0 if cfg.get(_config.ELASTIC) else 100.0
             jax.distributed.initialize(
                 coordinator_address=addr,
                 num_processes=n,
                 process_id=pid,
                 initialization_timeout=int(
                     cfg.get(_config.INIT_TIMEOUT_SECONDS)),
+                heartbeat_timeout_seconds=int(heartbeat),
+                shutdown_timeout_seconds=int(
+                    cfg.get(_config.SHUTDOWN_TIMEOUT_SECONDS)),
             )
             w.coordinator_addr = addr
         w.process_id = jax.process_index()

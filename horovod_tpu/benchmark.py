@@ -72,6 +72,148 @@ def _compiled_flops(jitted, *example_args) -> Optional[float]:
         return None
 
 
+class _Rig:
+    """Compiled benchmark state for one (model, batch) configuration.
+
+    Built once per batch size; ``run_stage`` can then be called repeatedly
+    (e.g. a quick low-iteration measurement followed by a longer one)
+    without recompiling — the jit cache lives on the ``train_step`` object
+    held here.
+    """
+
+    def __init__(self, batch_per_chip: int, image_size: int,
+                 model_name: str, optimizer_name: str):
+        import jax
+        import jax.numpy as jnp
+        import optax
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+        import horovod_tpu as hvd
+        from .models import ResNet50, ResNet18
+
+        if not hvd.is_initialized():
+            hvd.init()
+
+        devices = jax.devices()
+        self.n = n = len(devices)
+        self.batch_per_chip = batch_per_chip
+        self.global_batch = global_batch = batch_per_chip * n
+        self.platform = devices[0].platform
+        self.device_kind = getattr(devices[0], "device_kind", self.platform)
+
+        mesh = Mesh(np.array(devices), ("dp",))
+        batch_sharding = NamedSharding(mesh, P("dp"))
+        replicated = NamedSharding(mesh, P())
+
+        model = {"resnet50": ResNet50, "resnet18": ResNet18}[model_name](
+            num_classes=1000)
+
+        rng = jax.random.PRNGKey(0)
+        self.images = jax.device_put(
+            jax.random.normal(rng, (global_batch, image_size, image_size, 3),
+                              jnp.bfloat16), batch_sharding)
+        self.labels = jax.device_put(
+            jax.random.randint(rng, (global_batch,), 0, 1000), batch_sharding)
+
+        variables = jax.jit(
+            lambda: model.init(jax.random.PRNGKey(1),
+                               jnp.zeros((1, image_size, image_size, 3),
+                                         jnp.bfloat16), train=True),
+            out_shardings=replicated)()
+        self.params = variables["params"]
+        self.batch_stats = variables["batch_stats"]
+
+        # LR scaled by device count, the reference's hvd.size() recipe
+        # (examples/tensorflow2_synthetic_benchmark.py lr * hvd.size())
+        base = {"sgd": optax.sgd(0.01 * n, momentum=0.9),
+                "adam": optax.adam(1e-3)}[optimizer_name]
+        opt = hvd.DistributedOptimizer(base)
+        self.opt_state = jax.jit(opt.init, out_shardings=replicated)(
+            self.params)
+
+        def loss_fn(p, bs, x, y):
+            logits, updates = model.apply(
+                {"params": p, "batch_stats": bs}, x, train=True,
+                mutable=["batch_stats"])
+            loss = optax.softmax_cross_entropy_with_integer_labels(
+                logits, y).mean()
+            return loss, updates["batch_stats"]
+
+        def _step(p, bs, s, x, y):
+            (loss, bs), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(p, bs, x, y)
+            updates, s = opt.update(grads, s, p)
+            p = optax.apply_updates(p, updates)
+            return p, bs, s, loss
+
+        # donate params/batch_stats/opt_state so XLA updates them in place
+        self.train_step = jax.jit(_step, donate_argnums=(0, 1, 2))
+
+        self.flops_per_step = _compiled_flops(
+            self.train_step, self.params, self.batch_stats, self.opt_state,
+            self.images, self.labels)
+        if self.flops_per_step is None:
+            self.flops_per_step = (
+                _RESNET50_TRAIN_FLOPS_PER_IMAGE * global_batch)
+
+        self._warmed_up = 0
+
+    def _run_batches(self, k):
+        p, bs, s = self.params, self.batch_stats, self.opt_state
+        loss = None
+        for _ in range(k):
+            p, bs, s, loss = self.train_step(p, bs, s, self.images,
+                                             self.labels)
+        # Host readback (not just block_until_ready) to fence the timing:
+        # the whole step chain must have executed for the loss value to
+        # materialize; some PJRT transports complete block_until_ready on
+        # scalars before device execution finishes.
+        float(loss)
+        self.params, self.batch_stats, self.opt_state = p, bs, s
+
+    def run_stage(self, num_warmup_batches: int, num_batches_per_iter: int,
+                  num_iters: int, verbose: bool = False) -> BenchResult:
+        # Warmup counts accumulate: a second stage on an already-warm rig
+        # only runs whatever extra warmup it asked for beyond the first's.
+        extra = max(0, num_warmup_batches - self._warmed_up)
+        if extra:
+            self._run_batches(extra)
+            self._warmed_up += extra
+
+        durations = []
+        for i in range(num_iters):
+            t0 = time.perf_counter()
+            self._run_batches(num_batches_per_iter)
+            dt = time.perf_counter() - t0
+            durations.append(dt)
+            if verbose:
+                ips = self.global_batch * num_batches_per_iter / dt
+                print(f"Iter #{i}: {ips:.1f} img/sec total")
+
+        durations = np.array(durations)
+        imgs = self.global_batch * num_batches_per_iter
+        ips_total = float(np.mean(imgs / durations))
+
+        peak = peak_flops_per_chip(self.device_kind)
+        mfu = None
+        if peak and self.flops_per_step:
+            steps_per_sec = ips_total / self.global_batch
+            mfu = (self.flops_per_step * steps_per_sec) / (self.n * peak)
+
+        return BenchResult(
+            images_per_sec_per_chip=ips_total / self.n,
+            images_per_sec_total=ips_total,
+            num_chips=self.n,
+            batch_per_chip=self.batch_per_chip,
+            iter_mean_s=float(durations.mean()),
+            iter_std_s=float(durations.std()),
+            platform=self.platform,
+            device_kind=self.device_kind,
+            flops_per_step=self.flops_per_step,
+            mfu=mfu,
+        )
+
+
 def synthetic_resnet50_benchmark(
         batch_per_chip: int = 32,
         num_warmup_batches: int = 10,
@@ -81,117 +223,42 @@ def synthetic_resnet50_benchmark(
         model_name: str = "resnet50",
         optimizer_name: str = "sgd",
         verbose: bool = False) -> BenchResult:
-    import jax
-    import jax.numpy as jnp
-    import optax
-    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+    rig = _Rig(batch_per_chip, image_size, model_name, optimizer_name)
+    return rig.run_stage(num_warmup_batches, num_batches_per_iter,
+                         num_iters, verbose=verbose)
 
-    import horovod_tpu as hvd
-    from .models import ResNet50, ResNet18
 
-    if not hvd.is_initialized():
-        hvd.init()
+def synthetic_resnet50_ladder(stages, image_size: int = 224,
+                              model_name: str = "resnet50",
+                              optimizer_name: str = "sgd"):
+    """Generator: run ``stages`` cheapest-first, yielding
+    ``(BenchResult | None, error | None)`` per stage. Stages with the same
+    ``batch_per_chip`` share one compiled rig (no recompilation); changing
+    batch size frees the previous rig before building the next (HBM
+    hygiene).
 
-    devices = jax.devices()
-    n = len(devices)
-    mesh = Mesh(np.array(devices), ("dp",))
-    batch_sharding = NamedSharding(mesh, P("dp"))
-    replicated = NamedSharding(mesh, P())
+    Per-stage failures (e.g. a larger batch OOMing) are yielded as
+    ``(None, exc)`` rather than raised — raising out of a generator
+    exhausts it, which would silently cancel every remaining stage. A
+    failed stage also drops the rig (a fault mid-step can leave donated
+    buffers invalidated), so the next stage rebuilds from scratch.
 
-    model = {"resnet50": ResNet50, "resnet18": ResNet18}[model_name](
-        num_classes=1000)
-    global_batch = batch_per_chip * n
-
-    rng = jax.random.PRNGKey(0)
-    images = jax.device_put(
-        jax.random.normal(rng, (global_batch, image_size, image_size, 3),
-                          jnp.bfloat16), batch_sharding)
-    labels = jax.device_put(
-        jax.random.randint(rng, (global_batch,), 0, 1000), batch_sharding)
-
-    variables = jax.jit(
-        lambda: model.init(jax.random.PRNGKey(1),
-                           jnp.zeros((1, image_size, image_size, 3),
-                                     jnp.bfloat16), train=True),
-        out_shardings=replicated)()
-    params, batch_stats = variables["params"], variables["batch_stats"]
-
-    # LR scaled by device count, the reference's hvd.size() recipe
-    # (examples/tensorflow2_synthetic_benchmark.py lr * hvd.size())
-    base = {"sgd": optax.sgd(0.01 * n, momentum=0.9),
-            "adam": optax.adam(1e-3)}[optimizer_name]
-    opt = hvd.DistributedOptimizer(base)
-    opt_state = jax.jit(opt.init, out_shardings=replicated)(params)
-
-    def loss_fn(p, bs, x, y):
-        logits, updates = model.apply(
-            {"params": p, "batch_stats": bs}, x, train=True,
-            mutable=["batch_stats"])
-        loss = optax.softmax_cross_entropy_with_integer_labels(
-            logits, y).mean()
-        return loss, updates["batch_stats"]
-
-    def _step(p, bs, s, x, y):
-        (loss, bs), grads = jax.value_and_grad(
-            loss_fn, has_aux=True)(p, bs, x, y)
-        updates, s = opt.update(grads, s, p)
-        p = optax.apply_updates(p, updates)
-        return p, bs, s, loss
-
-    # donate params/batch_stats/opt_state so XLA updates them in place (HBM)
-    train_step = jax.jit(_step, donate_argnums=(0, 1, 2))
-
-    flops_per_step = _compiled_flops(
-        train_step, params, batch_stats, opt_state, images, labels)
-    if flops_per_step is None:
-        flops_per_step = _RESNET50_TRAIN_FLOPS_PER_IMAGE * global_batch
-
-    def run_batches(k, p, bs, s):
-        loss = None
-        for _ in range(k):
-            p, bs, s, loss = train_step(p, bs, s, images, labels)
-        # Host readback (not just block_until_ready) to fence the timing:
-        # the whole step chain must have executed for the loss value to
-        # materialize; some PJRT transports complete block_until_ready on
-        # scalars before device execution finishes.
-        float(loss)
-        return p, bs, s
-
-    params, batch_stats, opt_state = run_batches(
-        num_warmup_batches, params, batch_stats, opt_state)
-
-    durations = []
-    for i in range(num_iters):
-        t0 = time.perf_counter()
-        params, batch_stats, opt_state = run_batches(
-            num_batches_per_iter, params, batch_stats, opt_state)
-        dt = time.perf_counter() - t0
-        durations.append(dt)
-        if verbose:
-            ips = global_batch * num_batches_per_iter / dt
-            print(f"Iter #{i}: {ips:.1f} img/sec total")
-
-    durations = np.array(durations)
-    imgs = global_batch * num_batches_per_iter
-    ips_total = float(np.mean(imgs / durations))
-
-    platform = devices[0].platform
-    device_kind = getattr(devices[0], "device_kind", platform)
-    peak = peak_flops_per_chip(device_kind)
-    mfu = None
-    if peak and flops_per_step:
-        steps_per_sec = ips_total / global_batch
-        mfu = (flops_per_step * steps_per_sec) / (n * peak)
-
-    return BenchResult(
-        images_per_sec_per_chip=ips_total / n,
-        images_per_sec_total=ips_total,
-        num_chips=n,
-        batch_per_chip=batch_per_chip,
-        iter_mean_s=float(durations.mean()),
-        iter_std_s=float(durations.std()),
-        platform=platform,
-        device_kind=device_kind,
-        flops_per_step=flops_per_step,
-        mfu=mfu,
-    )
+    Each stage is a dict with keys ``batch_per_chip``,
+    ``num_warmup_batches``, ``num_batches_per_iter``, ``num_iters``.
+    The caller decides whether to pull the next stage — checking its
+    remaining wall-clock budget before paying the next compile.
+    """
+    rig = None
+    for st in stages:
+        b = st["batch_per_chip"]
+        try:
+            if rig is None or rig.batch_per_chip != b:
+                # free donated buffers before allocating the next batch
+                rig = None
+                rig = _Rig(b, image_size, model_name, optimizer_name)
+            yield rig.run_stage(st["num_warmup_batches"],
+                                st["num_batches_per_iter"],
+                                st["num_iters"]), None
+        except Exception as e:  # noqa: BLE001 — caller triages per stage
+            rig = None
+            yield None, e

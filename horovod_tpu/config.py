@@ -112,6 +112,18 @@ INIT_TIMEOUT_SECONDS = _register(
     "INIT_TIMEOUT_SECONDS", 300.0, float,
     alias="HOROVOD_GLOO_TIMEOUT_SECONDS",
     help="Timeout for distributed initialization / re-rendezvous.")
+HEARTBEAT_TIMEOUT_SECONDS = _register(
+    "HEARTBEAT_TIMEOUT_SECONDS", -1.0, float,
+    help="JAX coordination-service heartbeat timeout. Bounds how long a "
+         "surviving worker blocks on a dead peer before the runtime "
+         "declares the job failed. Default -1 = auto: 10s under an elastic "
+         "launch (a driver exists to respawn survivors, so fast detection "
+         "wins) and the jax default of 100s otherwise (no recovery path, "
+         "so tolerate transient stalls). The reference's analogous knob is "
+         "HOROVOD_GLOO_TIMEOUT_SECONDS, gloo_context.cc:65-68.")
+SHUTDOWN_TIMEOUT_SECONDS = _register(
+    "SHUTDOWN_TIMEOUT_SECONDS", 60.0, float,
+    help="JAX coordination-service shutdown barrier timeout.")
 
 # -- Consistency checking (replaces the reference controller's per-cycle
 #    dtype/shape validation, controller.cc:378-611) --------------------------

@@ -111,6 +111,7 @@ class ElasticDriver:
         self._worker_clients: Dict[Tuple[str, int],
                                    WorkerNotificationClient] = {}
 
+        self._pending_notice_ts: Optional[float] = None
         self._worker_registry = WorkerStateRegistry(
             self, self._host_manager, reset_limit=reset_limit)
         self._results = ResultsRecorder()
@@ -132,8 +133,12 @@ class ElasticDriver:
         self._create_worker_fn = create_worker_fn
         self._activate_workers(np)
 
-    def resume(self) -> None:
-        self._activate_workers(self._min_np)
+    def resume(self, respawn_all: bool = False) -> None:
+        """Form the next generation. ``respawn_all=True`` means every
+        process of the previous generation is known dead (peer-death
+        cascade), so every slot of the new generation must be spawned —
+        not only slots that were previously unassigned."""
+        self._activate_workers(self._min_np, respawn_all=respawn_all)
 
     def stop(self, error_message: Optional[str] = None) -> None:
         self._results.set_error_message(error_message)
@@ -209,9 +214,11 @@ class ElasticDriver:
                 self._wait_hosts_cond.wait(min(tmout.remaining(), 1.0))
                 tmout.check("minimum number of slots to become available")
 
-    def _activate_workers(self, min_np: int) -> None:
+    def _activate_workers(self, min_np: int,
+                          respawn_all: bool = False) -> None:
         current = self.wait_for_available_slots(min_np)
-        pending = self._update_host_assignments(current)
+        pending = self._update_host_assignments(current,
+                                                respawn_all=respawn_all)
         self._worker_registry.reset(self.world_size())
         for slot_info in pending:
             self._start_worker_process(slot_info)
@@ -222,8 +229,6 @@ class ElasticDriver:
             with self._wait_hosts_cond:
                 try:
                     if self._host_manager.update_available_hosts():
-                        self._notify_workers_host_changes(
-                            self._host_manager.current_hosts)
                         self._wait_hosts_cond.notify_all()
                 except RuntimeError:
                     if first:
@@ -234,14 +239,32 @@ class ElasticDriver:
                     log.warning("elastic: discovery failed; retrying",
                                 exc_info=True)
             first = False
+            # Every poll: (re)derive whether a host-change notice is owed
+            # and deliver it. Deriving from current state each cycle (not
+            # only on a discovery delta) makes the notice self-healing —
+            # a notice cleared by a concurrently forming generation, or a
+            # delivery that raced worker startup (coordinator service not
+            # registered yet), is simply recreated/retried a second later.
+            self._refresh_pending_notice()
+            self._deliver_pending_notice()
             self._shutdown.wait(DISCOVER_HOSTS_FREQUENCY_SECS)
 
-    def _notify_workers_host_changes(self, current: DiscoveredHosts) -> None:
-        next_assignments = {}
-        if current.count_available_slots() >= self._min_np:
-            next_assignments, _ = self._compute_assignments(current)
-        if next_assignments == self.host_assignments:
-            return  # membership changed but ranks would not
+    def _refresh_pending_notice(self) -> None:
+        with self._wait_hosts_cond:
+            current = self._host_manager.current_hosts
+            next_assignments = {}
+            if current.count_available_slots() >= self._min_np:
+                next_assignments, _ = self._compute_assignments(current)
+            if next_assignments == self.host_assignments:
+                # Current generation already reflects the membership.
+                self._pending_notice_ts = None
+            elif self._pending_notice_ts is None and self._host_assignments:
+                self._pending_notice_ts = time.time()
+
+    def _deliver_pending_notice(self) -> None:
+        ts = self._pending_notice_ts
+        if ts is None:
+            return
         coord = self.get_coordinator_info()
         if not coord:
             return
@@ -249,10 +272,11 @@ class ElasticDriver:
         if not client:
             return
         try:
-            client.notify_hosts_updated(time.time())
+            client.notify_hosts_updated(ts)
+            self._pending_notice_ts = None
         except Exception:
             log.debug("elastic: failed to notify coordinator of host "
-                      "changes", exc_info=True)
+                      "changes; will retry", exc_info=True)
 
     def _compute_assignments(self, current: DiscoveredHosts):
         host_list = [HostInfo(h, current.get_slots(h))
@@ -264,11 +288,13 @@ class ElasticDriver:
             by_host[s.hostname].append(s)
         return dict(by_host), assignment_list
 
-    def _update_host_assignments(self, current: DiscoveredHosts
+    def _update_host_assignments(self, current: DiscoveredHosts,
+                                 respawn_all: bool = False
                                  ) -> List[SlotInfo]:
-        active = {(host, s.local_rank)
-                  for host, slots in self._host_assignments.items()
-                  for s in slots}
+        active = set() if respawn_all else {
+            (host, s.local_rank)
+            for host, slots in self._host_assignments.items()
+            for s in slots}
         by_host, assignment_list = self._compute_assignments(current)
         if self._host_assignments:
             if not (self._host_assignments.keys() & by_host.keys()):
@@ -277,6 +303,9 @@ class ElasticDriver:
                     "no surviving rank to broadcast state from")
         self._host_assignments = by_host
         self._world_size = len(assignment_list)
+        # The generation being formed already reflects current membership;
+        # a pending host-change notice would only re-interrupt it.
+        self._pending_notice_ts = None
         self._rendezvous.init(assignment_list)
         if self._assignments_callback is not None:
             self._assignments_callback(assignment_list)

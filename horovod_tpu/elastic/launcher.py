@@ -9,9 +9,12 @@ event or the host's change event fires.
 
 import os
 import random
+import shutil
 import socket
+import tempfile
 import threading
 import time
+import uuid
 from typing import List, Optional
 
 from ..runner import config_parser
@@ -116,6 +119,24 @@ def launch_elastic(args) -> int:
     driver.set_assignments_callback(publish_coordinator)
 
     base_env = config_parser.set_env_from_args(dict(os.environ), args)
+    # Job-scoped durable-commit directory: workers persist every commit()
+    # here so a slot respawned after a hard kill restores its last commit
+    # (see elastic/run.py STATE_DIR_ENV). A user-provided
+    # HVD_TPU_ELASTIC_STATE_DIR is honored (point it at shared storage on
+    # multi-host clusters — a launcher-local mkdtemp path does not exist on
+    # remote hosts, where workers then mkdir it themselves per-host and
+    # recovery degrades to the rank-0 broadcast). Only the dir this
+    # launcher created is cleaned up afterwards.
+    state_dir = base_env.get("HVD_TPU_ELASTIC_STATE_DIR")
+    own_state_dir = None
+    if not state_dir:
+        state_dir = own_state_dir = tempfile.mkdtemp(
+            prefix="hvd_tpu_elastic_job_")
+        base_env["HVD_TPU_ELASTIC_STATE_DIR"] = state_dir
+    # Job-unique token namespacing the commit files, so a reused shared
+    # state dir never resurrects a previous job's state.
+    base_env.setdefault("HVD_TPU_ELASTIC_JOB_ID",
+                        uuid.uuid4().hex[:12])
     rdv_host = socket.gethostname()
     try:
         socket.gethostbyname(rdv_host)
@@ -129,9 +150,13 @@ def launch_elastic(args) -> int:
     # First generation targets the requested -np (reference: launch_gloo_
     # elastic starts at settings.num_proc); later resumes shrink/grow within
     # [min_np, max_np].
-    driver.start(args.np or min_np, create_worker_fn)
-    results = driver.get_results()
-    driver.stop()
+    try:
+        driver.start(args.np or min_np, create_worker_fn)
+        results = driver.get_results()
+        driver.stop()
+    finally:
+        if own_state_dir:
+            shutil.rmtree(own_state_dir, ignore_errors=True)
 
     if results.error_message:
         import sys

@@ -34,6 +34,7 @@ class WorkerStateRegistry:
         self._lock = threading.Lock()
         self._states: dict = {}
         self._by_state: dict = {READY: set(), SUCCESS: set(), FAILURE: set()}
+        self._failure_order: list = []   # (host, slot) in arrival order
         self._barrier: Optional[threading.Barrier] = None
         self._rendezvous_id = 0
         self._size = 0
@@ -60,6 +61,7 @@ class WorkerStateRegistry:
             self._states.clear()
             for s in self._by_state.values():
                 s.clear()
+            self._failure_order.clear()
             self._barrier = threading.Barrier(parties=size,
                                               action=self._on_all_recorded)
             self._rendezvous_id += 1
@@ -99,6 +101,8 @@ class WorkerStateRegistry:
                     return self._rendezvous_id
             self._states[key] = state
             self.get(state).add(key)
+            if state == FAILURE and key not in self._failure_order:
+                self._failure_order.append(key)
             rid = self._rendezvous_id
 
         return self._wait(key, state, rid)
@@ -126,13 +130,36 @@ class WorkerStateRegistry:
                      self.count(SUCCESS))
             self._driver.stop()
             return
+        respawn_all = False
         if self.count(FAILURE) == self._size:
-            log.error("elastic: all %d workers failed; stopping job",
-                      self._size)
-            self._driver.stop()
-            return
-        for host, _slot in self.get(FAILURE):
-            self._host_manager.blacklist(host)
+            # Total loss of the generation. On this runtime a single hard
+            # worker death takes down every peer: survivors block in a
+            # collective, the JAX coordination service detects the missed
+            # heartbeat and fatally terminates them. "All failed" therefore
+            # does NOT mean every host is bad — the root cause is the
+            # FIRST recorded failure (peers die a heartbeat-timeout later).
+            # Blacklist only the root host and respawn the remainder; a
+            # genuinely-broken job converges anyway (one blacklist per
+            # generation until min_np is unreachable or reset_limit hits).
+            root = self._failure_order[0] if self._failure_order else None
+            survivors = [h for h, _ in self.recorded_slots()
+                         if root is not None and h != root[0]
+                         and not self._host_manager.is_blacklisted(h)]
+            if root is None or not survivors:
+                log.error("elastic: all %d workers failed with no "
+                          "surviving host; stopping job", self._size)
+                self._driver.stop()
+                return
+            log.warning(
+                "elastic: all %d workers failed; treating as a cascade "
+                "rooted at %s[%s] (first failure) — blacklisting %s and "
+                "respawning the surviving hosts %s",
+                self._size, root[0], root[1], root[0], survivors)
+            self._host_manager.blacklist(root[0])
+            respawn_all = True
+        else:
+            for host, _slot in self.get(FAILURE):
+                self._host_manager.blacklist(host)
         if all(self._host_manager.is_blacklisted(h)
                for h, _ in self.recorded_slots()):
             log.error("elastic: every active host is blacklisted; stopping")
@@ -145,7 +172,7 @@ class WorkerStateRegistry:
             return
         try:
             self._reset_count += 1
-            self._driver.resume()
+            self._driver.resume(respawn_all=respawn_all)
         except Exception:
             log.exception("elastic: failed to resume with new hosts")
             self._driver.stop()

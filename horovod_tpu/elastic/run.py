@@ -48,6 +48,14 @@ log = logging.getLogger("horovod_tpu.elastic")
 RANK_ENV = ("HVD_TPU_RANK", "HVD_TPU_SIZE", "HVD_TPU_LOCAL_RANK",
             "HVD_TPU_LOCAL_SIZE", "HVD_TPU_CROSS_RANK", "HVD_TPU_CROSS_SIZE")
 RESTART_STATE_ENV = "HVD_TPU_RESTART_STATE_FILE"
+#: Job-scoped directory (set by the elastic launcher) where every commit()
+#: persists the committed snapshot. A worker hard-killed by the runtime
+#: (e.g. the JAX coordination service fatally terminating survivors of a
+#: peer death) cannot run the graceful pre-exec persistence path below, so
+#: durability must be paid at commit time — the same contract as the
+#: reference, where the survivor's in-memory committed state survives
+#: because the survivor process itself survives (common/elastic.py:60-101).
+STATE_DIR_ENV = "HVD_TPU_ELASTIC_STATE_DIR"
 
 
 def _rendezvous_client(timeout: float = 24 * 3600.0):
@@ -100,24 +108,101 @@ def _persist_state(state) -> None:
     os.environ[RESTART_STATE_ENV] = path
 
 
-def maybe_load_persisted_state(state) -> bool:
-    """Reload a pre-exec snapshot into ``state`` (restarted workers only)."""
-    path = os.environ.pop(RESTART_STATE_ENV, None)
-    if not path or not os.path.exists(path):
-        return False
+def committed_state_path() -> "str | None":
+    """This worker's durable commit file, or None outside elastic launches.
+
+    The filename carries the launcher's job id so a reused (e.g. shared-
+    storage) state dir can never hand a new job a previous job's final
+    state.
+    """
+    d = os.environ.get(STATE_DIR_ENV)
+    if not d:
+        return None
+    import socket
+    hostname = os.environ.get("HVD_TPU_HOSTNAME") or socket.gethostname()
+    local_rank = os.environ.get("HVD_TPU_LOCAL_RANK", "0")
+    job = os.environ.get("HVD_TPU_ELASTIC_JOB_ID", "job")
+    return os.path.join(d, f"state_{job}_{hostname}_{local_rank}.pkl")
+
+
+def persist_committed_state(state) -> None:
+    """Durably persist the committed snapshot (called from State.commit()).
+
+    Atomic write+rename so a kill mid-commit leaves the previous commit
+    intact. Strictly best-effort: persistence failures (unwritable dir,
+    unpicklable user attribute, full disk) must never turn a commit that
+    used to succeed into a training crash — recovery then degrades to the
+    rank-0 broadcast, exactly the pre-durability behavior. No-op outside
+    elastic launches (no STATE_DIR_ENV) or when
+    HVD_TPU_ELASTIC_DURABLE_COMMITS=0 (opt-out for huge states committed
+    every batch, where the synchronous pickle+write would dominate step
+    time).
+    """
+    if os.environ.get("HVD_TPU_ELASTIC_DURABLE_COMMITS", "1") == "0":
+        return
+    path = committed_state_path()
+    if not path:
+        return
+    saved = getattr(state, "_saved_state", None)
+    if saved is None:
+        return
+    tmp = f"{path}.tmp.{os.getpid()}"
     try:
-        with open(path, "rb") as f:
-            saved = pickle.load(f)
+        # Remote hosts may not have the launcher-created dir; best-effort
+        # local persistence still covers same-host respawns.
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        with open(tmp, "wb") as f:
+            pickle.dump(saved, f)
+        os.replace(tmp, path)
+    except Exception:  # noqa: BLE001 — durability is best-effort by contract
+        log.warning("elastic: failed to persist committed state to %s",
+                    path, exc_info=True)
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+
+
+def maybe_load_persisted_state(state) -> bool:
+    """Reload a persisted snapshot into ``state``.
+
+    Two sources, in priority order:
+    1. the pre-exec snapshot file (graceful re-exec reset path);
+    2. this slot's durable commit file (driver-respawned workers whose
+       predecessor was hard-killed by the runtime).
+    Brand-new workers have neither and get state from the rank-0 broadcast
+    in ``state.sync()``.
+    """
+    path = os.environ.pop(RESTART_STATE_ENV, None)
+    if path and os.path.exists(path):
+        try:
+            with open(path, "rb") as f:
+                saved = pickle.load(f)
+            if hasattr(state, "_saved_state"):
+                state._saved_state = saved
+                state.restore()
+                return True
+            return False
+        finally:
+            try:
+                os.unlink(path)
+            except OSError:
+                pass
+    commit_path = committed_state_path()
+    if commit_path and os.path.exists(commit_path):
+        try:
+            with open(commit_path, "rb") as f:
+                saved = pickle.load(f)
+        except (OSError, pickle.UnpicklingError):
+            log.warning("elastic: could not reload committed state from %s",
+                        commit_path, exc_info=True)
+            return False
         if hasattr(state, "_saved_state"):
+            log.info("elastic: restored committed state from %s", commit_path)
             state._saved_state = saved
             state.restore()
             return True
-        return False
-    finally:
-        try:
-            os.unlink(path)
-        except OSError:
-            pass
+    return False
 
 
 def reset(state=None) -> None:

@@ -59,6 +59,12 @@ class State:
 
     def commit(self) -> None:
         self.save()
+        # Durability on EVERY commit, not just the graceful re-exec path:
+        # a worker hard-killed by the runtime (peer-death cascade through
+        # the JAX coordination service) must still find its last commit on
+        # disk when the driver respawns its slot.
+        from .run import persist_committed_state
+        persist_committed_state(self)
         self.check_host_updates()
 
     def check_host_updates(self) -> None:

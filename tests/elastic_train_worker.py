@@ -15,6 +15,7 @@ Env contract from the harness:
 
 import os
 import sys
+import time
 
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
 os.environ.pop("XLA_FLAGS", None)
@@ -29,6 +30,11 @@ import horovod_tpu as hvd  # noqa: E402
 
 TEST_DIR = os.environ["ELASTIC_TEST_DIR"]
 EPOCHS = int(os.environ.get("ELASTIC_TEST_EPOCHS", "4"))
+# Per-epoch pacing: the reference's integration harness paces epochs so a
+# mid-run discovery change has a window to land before training finishes
+# (elastic_common.py epoch scheduling); without it these tiny epochs
+# complete in milliseconds and no membership event can ever interrupt.
+EPOCH_SLEEP = float(os.environ.get("ELASTIC_TEST_EPOCH_SLEEP", "0.3"))
 KILL_RANK = os.environ.get("ELASTIC_TEST_KILL_RANK")
 KILL_EPOCH = int(os.environ.get("ELASTIC_TEST_KILL_EPOCH", "-1"))
 KILL_MARKER = os.path.join(TEST_DIR, "killed.marker")
@@ -48,6 +54,7 @@ def main():
     @hvd.elastic.run
     def train(state):
         while state.epoch < EPOCHS:
+            time.sleep(EPOCH_SLEEP)
             epoch_sum = 0.0
             for b in range(2):
                 out = hvd.allreduce(

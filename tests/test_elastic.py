@@ -375,3 +375,93 @@ def test_elastic_rendezvous_and_requery(monkeypatch):
         assert os.environ["HVD_TPU_COORDINATOR_ADDR"] == "10.0.0.9:4321"
     finally:
         rdv.stop()
+
+
+# ---------------------------------------------------------------------------
+# peer-death cascade + durable commits (round-3 elastic recovery semantics)
+# ---------------------------------------------------------------------------
+def test_driver_cascade_total_failure_respawns_survivors():
+    """All workers of a generation die (one root crash + runtime-killed
+    peers): the driver must blacklist only the ROOT host (first recorded
+    failure) and respawn the survivors' generation — not stop the job
+    (reference semantics: registration.py blacklists failing hosts and
+    driver.resume()s; here 'all failed' is a cascade artifact of the JAX
+    coordination service killing survivors of a peer death)."""
+    rdv = FakeRendezvous()
+    driver = ElasticDriver(rdv, FixedHosts({"h1": 1, "h2": 1}),
+                           min_np=1, max_np=2, timeout=10)
+    spawns = []
+
+    def create_worker(slot_info, events):
+        spawns.append((slot_info.hostname, slot_info.rank, slot_info.size))
+        if len(spawns) <= 2:
+            # Generation 0: h2's worker crashes first (the root), then
+            # h1's worker is killed by the runtime a moment later.
+            if slot_info.hostname == "h2":
+                return 17, time.time()
+            time.sleep(0.2)
+            return 1, time.time()
+        # Generation 1: the respawned survivor finishes.
+        return 0, time.time()
+
+    driver.start(2, create_worker)
+    results = driver.get_results()
+    assert results.error_message is None
+    assert driver._host_manager.is_blacklisted("h2")
+    assert not driver._host_manager.is_blacklisted("h1")
+    # the survivor host's slot was respawned even though it was "active"
+    gen1 = [s for s in spawns[2:]]
+    assert gen1 == [("h1", 0, 1)], spawns
+    code, _ = results.worker_results["h1[0]"]
+    assert code == 0
+    driver.stop()
+
+
+def test_driver_cascade_single_host_still_stops():
+    """A cascade needs a surviving host; when every slot lives on the root
+    host, total failure still stops the job."""
+    rdv = FakeRendezvous()
+    driver = ElasticDriver(rdv, FixedHosts({"h1": 2}), min_np=2, timeout=10)
+
+    def create_worker(slot_info, events):
+        return 7, time.time()
+
+    driver.start(2, create_worker)
+    results = driver.get_results()
+    assert driver.finished()
+    # stop path, not cascade: h1 is not blacklisted and nothing respawned
+    assert not driver._host_manager.is_blacklisted("h1")
+    assert len(results.worker_results) == 2
+    assert all(code == 7 for code, _ in results.worker_results.values())
+    driver.stop()
+
+
+def test_commit_persists_and_reloads(tmp_path, monkeypatch):
+    """commit() writes a durable snapshot; a fresh State on the same slot
+    reloads it (the driver-respawn recovery path, not just re-exec)."""
+    from horovod_tpu.elastic.run import maybe_load_persisted_state
+
+    monkeypatch.setenv("HVD_TPU_ELASTIC_STATE_DIR", str(tmp_path))
+    monkeypatch.setenv("HVD_TPU_HOSTNAME", "hostA")
+    monkeypatch.setenv("HVD_TPU_LOCAL_RANK", "0")
+
+    s1 = ObjectState(bcast_object=lambda obj, **kw: obj,
+                     get_rank=lambda: 0, epoch=0, total=0.0)
+    s1.epoch = 3
+    s1.total = 12.5
+    s1.commit()
+    files = list(tmp_path.iterdir())
+    assert [f.name for f in files] == ["state_job_hostA_0.pkl"]
+
+    # hard-kill simulation: brand-new process state, no RESTART_STATE_FILE
+    s2 = ObjectState(bcast_object=lambda obj, **kw: obj,
+                     get_rank=lambda: 0, epoch=0, total=0.0)
+    assert maybe_load_persisted_state(s2)
+    assert s2.epoch == 3 and s2.total == 12.5
+
+    # a different slot must NOT pick up this snapshot
+    monkeypatch.setenv("HVD_TPU_LOCAL_RANK", "1")
+    s3 = ObjectState(bcast_object=lambda obj, **kw: obj,
+                     get_rank=lambda: 0, epoch=0, total=0.0)
+    assert not maybe_load_persisted_state(s3)
+    assert s3.epoch == 0

@@ -43,6 +43,9 @@ def _launch(test_dir: str, hosts: str, extra_env=None, np_=2, min_np=1,
 
     env = dict(os.environ)
     env.pop("XLA_FLAGS", None)
+    # CPU-only test: ensure no accelerator-plugin sitecustomize (e.g. the
+    # axon PJRT relay) dials TPU hardware from every worker interpreter.
+    env.pop("PALLAS_AXON_POOL_IPS", None)
     env.update({
         "PYTHONPATH": REPO + os.pathsep + env.get("PYTHONPATH", ""),
         "JAX_PLATFORMS": "cpu",
@@ -121,6 +124,7 @@ def test_elastic_scale_up_mid_training():
     with tempfile.TemporaryDirectory() as td:
         proc, hosts_file = _launch(
             td, "localhost:1", np_=1, min_np=1, epochs=6,
+            extra_env={"ELASTIC_TEST_EPOCH_SLEEP": "1.5"},
             extra_args=("--max-np", "2"))
         # wait for training to actually start, then add a host
         deadline = time.time() + 120

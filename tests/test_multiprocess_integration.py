@@ -29,6 +29,7 @@ def _launch(n, extra_env=None, timeout=180):
     for pid in range(n):
         env = dict(os.environ)
         env.pop("XLA_FLAGS", None)
+        env.pop("PALLAS_AXON_POOL_IPS", None)
         repo_root = os.path.dirname(os.path.dirname(os.path.abspath(WORKER)))
         env.update({
             "PYTHONPATH": repo_root + os.pathsep + env.get("PYTHONPATH", ""),
@@ -79,6 +80,7 @@ def test_multiprocess_join_uneven_data(n):
     for pid in range(n):
         env = dict(os.environ)
         env.pop("XLA_FLAGS", None)
+        env.pop("PALLAS_AXON_POOL_IPS", None)
         repo_root = os.path.dirname(
             os.path.dirname(os.path.abspath(JOIN_WORKER)))
         env.update({
