@@ -1,0 +1,121 @@
+"""Compiled-plane autotuning: pick the fastest program variant by
+measurement, identically on every process.
+
+The reference tunes its hot path online — fusion threshold, cycle time,
+cache, hierarchical-allreduce on/off — scored on measured throughput, with
+rank 0's choice broadcast to all workers
+(/root/reference/horovod/common/parameter_manager.h:33-105,
+controller.cc:33-47 SynchronizeParameters). On TPU the hot path is a
+compiled XLA program: there is no per-cycle knob to nudge, but the SAME
+decision exists one level up — *which program to compile*. The tunable
+surface here:
+
+* reduction strategy per mesh axis: ``hierarchical`` (inner-axis mean
+  first — rides ICI — then the outer axis, the NCCLHierarchicalAllreduce
+  shape, nccl_operations.cc:178-372) vs ``flat`` (one collective over all
+  axes);
+* gradient packing: ``per_leaf`` (one psum per gradient, XLA's collective
+  combiner fuses) vs ``packed`` (explicit flat buffer per dtype — the
+  fusion-buffer shape, fusion_buffer_manager.h:30-55).
+
+Protocol: every process times each variant in the same deterministic
+order (variants are collectives — all processes must run them in
+lockstep), then rank 0's fastest is broadcast and adopted everywhere, so
+all processes end up compiling the identical program.
+
+The eager-plane fusion threshold keeps its own online tuner
+(parameter_manager.py); this module is its compiled-plane sibling.
+"""
+
+import time
+from typing import Callable, Dict, Optional, Sequence, Tuple
+
+import numpy as np
+
+from . import basics as _basics
+from . import collectives as _c
+
+
+def autotune_variants(variants: Dict[str, Callable], args: Sequence = (),
+                      warmup: int = 1, iters: int = 3,
+                      key: str = "default"
+                      ) -> Tuple[str, Callable, Dict[str, float]]:
+    """Measure each variant and return ``(chosen_name, chosen_fn, times)``.
+
+    Variants run in sorted-name order on every process (they may contain
+    collectives, so the order must be identical everywhere). The choice is
+    rank 0's measured argmin, broadcast so every process adopts the same
+    variant (reference: SynchronizeParameters, controller.cc:33-47).
+    """
+    import jax
+    if not variants:
+        raise ValueError("no variants to tune over")
+    names = sorted(variants)
+    times: Dict[str, float] = {}
+    for n in names:
+        fn = variants[n]
+        for _ in range(max(0, warmup)):
+            jax.block_until_ready(fn(*args))
+        t0 = time.perf_counter()
+        for _ in range(max(1, iters)):
+            jax.block_until_ready(fn(*args))
+        times[n] = (time.perf_counter() - t0) / max(1, iters)
+    best_idx = names.index(min(names, key=lambda n: times[n]))
+    w = _basics.world()
+    if w.num_processes > 1:
+        out = _c.broadcast(np.array([best_idx], np.int32), root_rank=0,
+                           name=f"hvd_tpu.autotune.compiled.{key}")
+        best_idx = int(np.asarray(out)[0])
+    chosen = names[best_idx]
+    _log_choice(w, key, chosen, times)
+    return chosen, variants[chosen], times
+
+
+def _log_choice(w, key: str, chosen: str, times: Dict[str, float]) -> None:
+    from . import config as _config
+    path = w.config.get(_config.AUTOTUNE_LOG)
+    if not path or w.process_id != 0:
+        return
+    try:
+        with open(path, "a") as f:
+            f.write(f"{time.strftime('%Y-%m-%d %H:%M:%S')} compiled[{key}] "
+                    f"chose {chosen}; times="
+                    + ", ".join(f"{k}={v:.6f}s" for k, v in
+                                sorted(times.items())) + "\n")
+    except OSError:
+        pass
+
+
+def tune_distributed_step(make_step: Callable[..., Callable],
+                          args: Sequence = (),
+                          strategies: Sequence[str] = ("hierarchical",
+                                                       "flat"),
+                          packings: Sequence[str] = ("per_leaf", "packed"),
+                          warmup: int = 1, iters: int = 3,
+                          key: str = "train_step"
+                          ) -> Tuple[dict, Callable]:
+    """Tune a training step over the compiled-plane reduction options.
+
+    ``make_step(reduce_strategy=..., packing=...)`` must return a callable
+    (typically a fresh ``jax.jit`` of a step built around a
+    ``DistributedOptimizer`` constructed with those options). Every
+    combination is compiled and measured; the fastest (rank-0-adopted)
+    wins. Returns ``({"reduce_strategy": s, "packing": p}, step_fn)``.
+
+    Example::
+
+        def make_step(reduce_strategy, packing):
+            opt = hvd.DistributedOptimizer(
+                optax.sgd(0.01), axis_name="dp", inner_axis="ici",
+                reduce_strategy=reduce_strategy, packing=packing)
+            ... build and jit the step ...
+            return step
+        options, step = tune_distributed_step(make_step, (params, batch))
+    """
+    variants = {
+        f"{s}/{p}": make_step(reduce_strategy=s, packing=p)
+        for s in strategies for p in packings}
+    chosen, fn, times = autotune_variants(
+        variants, args, warmup=warmup, iters=iters, key=key)
+    s, p = chosen.split("/", 1)
+    return {"reduce_strategy": s, "packing": p}, fn

@@ -30,12 +30,56 @@ def _require_mxnet():
         ) from e
 
 
-def allreduce(tensor, average: bool = True, name: Optional[str] = None):
+def _to_mx(out, like):
+    import numpy as np
     mx = _require_mxnet()
+    return mx.nd.array(np.asarray(out), dtype=like.dtype)
+
+
+def allreduce(tensor, average: bool = True, name: Optional[str] = None):
+    _require_mxnet()
     from .. import collectives as _c
     out = _c.allreduce(tensor.asnumpy(), average=average, name=name)
-    import numpy as np
-    return mx.nd.array(np.asarray(out), dtype=tensor.dtype)
+    return _to_mx(out, tensor)
+
+
+def grouped_allreduce(tensors, average: bool = True,
+                      name: Optional[str] = None):
+    """Fused allreduce of several NDArrays (reference:
+    mxnet/mpi_ops.py grouped_allreduce)."""
+    _require_mxnet()
+    from .. import collectives as _c
+    outs = _c.grouped_allreduce([t.asnumpy() for t in tensors],
+                                average=average, name=name)
+    return [_to_mx(o, t) for o, t in zip(outs, tensors)]
+
+
+def allgather(tensor, name: Optional[str] = None):
+    """Concatenate every process's tensor along dim 0 (reference:
+    mxnet/mpi_ops.py:84-107 allgather)."""
+    _require_mxnet()
+    from .. import collectives as _c
+    out = _c.allgather(tensor.asnumpy(), name=name)
+    return _to_mx(out, tensor)
+
+
+def broadcast(tensor, root_rank: int = 0, name: Optional[str] = None):
+    _require_mxnet()
+    from .. import collectives as _c
+    out = _c.broadcast(tensor.asnumpy(), root_rank=root_rank, name=name)
+    return _to_mx(out, tensor)
+
+
+def alltoall(tensor, splits=None, name: Optional[str] = None):
+    _require_mxnet()
+    from .. import collectives as _c
+    out = _c.alltoall(tensor.asnumpy(), splits=splits, name=name)
+    return _to_mx(out, tensor)
+
+
+def broadcast_object(obj, root_rank: int = 0, name: Optional[str] = None):
+    from ..functions import broadcast_object as _bo
+    return _bo(obj, root_rank=root_rank, name=name)
 
 
 def broadcast_parameters(params, root_rank: int = 0):
@@ -64,3 +108,57 @@ def DistributedOptimizer(optimizer):
 
     optimizer.__class__ = _Dist
     return optimizer
+
+
+def DistributedTrainer(params, optimizer, optimizer_params=None,
+                       compression=None, gradient_predivide_factor: float = 1.0):
+    """gluon Trainer whose ``_allreduce_grads`` reduces through the XLA
+    collective plane (reference: mxnet/__init__.py:84-107
+    DistributedTrainer: rescale_grad divided by world size, per-parameter
+    allreduce of live grads; here consecutive ready grads fuse through
+    grouped_allreduce).
+
+    Returns an *instance* (the class is built lazily so the module imports
+    without mxnet installed).
+    """
+    mx = _require_mxnet()
+    from .. import basics as _basics
+
+    class _DistributedTrainer(mx.gluon.Trainer):
+        def __init__(self, params_, optimizer_, optimizer_params_):
+            if type(optimizer_).__name__ == "_Dist":
+                raise ValueError(
+                    "pass a plain optimizer (or its name) to "
+                    "DistributedTrainer; it applies the distributed "
+                    "reduction itself (reference mxnet/__init__.py:90)")
+            super().__init__(params_, optimizer_,
+                             optimizer_params_, kvstore=None)
+            # the reference divides rescale_grad by size so the allreduce
+            # SUM yields the average (mxnet/__init__.py:95-99)
+            self._scale /= (_basics.size() * gradient_predivide_factor)
+            self._hvd_predivide = gradient_predivide_factor
+
+        def _allreduce_grads(self):
+            import numpy as np
+            from .. import collectives as _c
+            live = [(i, p) for i, p in enumerate(self._params)
+                    if p.grad_req != "null"]
+            if not live:
+                return
+            grads = [p.list_grad()[0] for _, p in live]
+            if compression is not None:
+                pairs = [compression.compress(g.asnumpy()) for g in grads]
+                outs = _c.grouped_allreduce(
+                    [c for c, _ in pairs], average=False,
+                    name="mx.trainer.grads")
+                outs = [compression.decompress(o, ctx)
+                        for o, (_, ctx) in zip(outs, pairs)]
+            else:
+                outs = _c.grouped_allreduce(
+                    [g.asnumpy() for g in grads], average=False,
+                    name="mx.trainer.grads")
+            for (i, p), out in zip(live, outs):
+                p.list_grad()[0][:] = mx.nd.array(
+                    np.asarray(out), dtype=p.list_grad()[0].dtype)
+
+    return _DistributedTrainer(params, optimizer, optimizer_params)

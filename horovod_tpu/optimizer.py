@@ -46,11 +46,23 @@ class DistributedGradientTransform:
                  inner_axis: Optional[str] = None,
                  compression=Compression.none,
                  prescale_factor: float = 1.0, postscale_factor: float = 1.0,
-                 name_prefix: str = "DistributedOptimizer"):
+                 name_prefix: str = "DistributedOptimizer",
+                 reduce_strategy: str = "hierarchical",
+                 packing: str = "per_leaf"):
         if op not in (_c.Average, _c.Sum, _c.Adasum):
             raise ValueError(
                 "DistributedOptimizer supports op=Average/Sum/Adasum "
                 "(reference: torch/optimizer.py op argument).")
+        if reduce_strategy not in ("hierarchical", "flat"):
+            raise ValueError("reduce_strategy must be 'hierarchical' "
+                             "(inner axis first, then outer — the "
+                             "NCCLHierarchicalAllreduce shape) or 'flat' "
+                             "(one collective over all axes)")
+        if packing not in ("per_leaf", "packed"):
+            raise ValueError("packing must be 'per_leaf' (one psum per "
+                             "gradient leaf, XLA fuses) or 'packed' (one "
+                             "flat buffer per dtype — the explicit fusion-"
+                             "buffer shape, fusion_buffer_manager.h:30-55)")
         self._base = base
         self._op = op
         self._axis_name = axis_name
@@ -59,6 +71,8 @@ class DistributedGradientTransform:
         self._prescale = prescale_factor
         self._postscale = postscale_factor
         self._prefix = name_prefix
+        self._strategy = reduce_strategy
+        self._packing = packing
         self._step = 0
 
     # optax protocol ---------------------------------------------------------
@@ -92,20 +106,62 @@ class DistributedGradientTransform:
         def red(g):
             if self._prescale != 1.0:
                 g = g * self._prescale
-            if self._inner_axis is not None:
+            if self._inner_axis is not None \
+                    and self._strategy == "hierarchical":
                 # hierarchical: reduce fast inner axis first (ICI), then
                 # outer (DCN) — NCCLHierarchicalAllreduce shape,
                 # nccl_operations.cc:178-372; XLA emits this as two
                 # collectives that ride the right links.
                 g = jax.lax.pmean(g, self._inner_axis)
-            if self._op == _c.Average:
-                g = jax.lax.pmean(g, self._axis_name)
+                axes = self._axis_name
+            elif self._inner_axis is not None:
+                # flat: ONE collective over both axes; divide by the inner
+                # size so the result matches the hierarchical semantics
+                # (inner mean, outer op). Which wins depends on topology —
+                # that's what compiled_autotune measures.
+                axes = (self._inner_axis, self._axis_name)
             else:
-                g = jax.lax.psum(g, self._axis_name)
+                axes = self._axis_name
+            if self._op == _c.Average:
+                g = jax.lax.pmean(g, axes)
+            else:
+                g = jax.lax.psum(g, axes)
+                if isinstance(axes, tuple):
+                    g = g / jax.lax.psum(1.0, self._inner_axis)
             if self._postscale != 1.0:
                 g = g * self._postscale
             return g
+
+        if self._packing == "packed":
+            return self._packed_tree_reduce(grads, red)
         return jax.tree_util.tree_map(red, grads)
+
+    @staticmethod
+    def _packed_tree_reduce(grads, red):
+        """Concatenate all leaves of each dtype into one flat buffer, run
+        ONE reduction per dtype, and scatter back — the explicit analogue
+        of the reference's fusion buffer (one fused collective per dtype
+        group, controller.cc:640-761 FuseResponses), for cases where XLA's
+        own collective combining leaves throughput on the table."""
+        import jax
+        import jax.numpy as jnp
+        leaves, treedef = jax.tree_util.tree_flatten(grads)
+        by_dtype = {}
+        for i, l in enumerate(leaves):
+            by_dtype.setdefault(jnp.result_type(l), []).append(i)
+        out = [None] * len(leaves)
+        for dt in sorted(by_dtype, key=str):
+            idxs = by_dtype[dt]
+            flat = jnp.concatenate(
+                [jnp.ravel(jnp.asarray(leaves[i])) for i in idxs])
+            r = red(flat)
+            off = 0
+            for i in idxs:
+                shape = jnp.shape(leaves[i])
+                n = int(np.prod(shape, dtype=np.int64)) if shape else 1
+                out[i] = r[off:off + n].reshape(shape)
+                off += n
+        return jax.tree_util.tree_unflatten(treedef, out)
 
     def _reduce_eager(self, grads):
         import jax
@@ -158,18 +214,25 @@ def DistributedOptimizer(optimizer, named_parameters=None,
                          op=_c.Average, axis_name: Optional[str] = None,
                          inner_axis: Optional[str] = None,
                          prescale_factor: float = 1.0,
-                         postscale_factor: float = 1.0):
+                         postscale_factor: float = 1.0,
+                         reduce_strategy: str = "hierarchical",
+                         packing: str = "per_leaf"):
     """Wrap an optax optimizer so gradients are reduced across the world
     before each update (reference: hvd.DistributedOptimizer,
     torch/optimizer.py:372-420 factory).
 
     ``named_parameters`` is accepted for reference API parity; optax
     gradients are pytrees so names are derived from tree paths instead.
+    ``reduce_strategy``/``packing`` select the compiled-plane reduction
+    shape; :func:`horovod_tpu.compiled_autotune.tune_distributed_step`
+    measures the variants and picks the fastest identically on every
+    process.
     """
     dist = DistributedGradientTransform(
         optimizer, op=op, axis_name=axis_name, inner_axis=inner_axis,
         compression=compression, prescale_factor=prescale_factor,
-        postscale_factor=postscale_factor)
+        postscale_factor=postscale_factor,
+        reduce_strategy=reduce_strategy, packing=packing)
     if backward_passes_per_step > 1:
         import optax
         return optax.MultiSteps(dist, every_k_schedule=backward_passes_per_step)

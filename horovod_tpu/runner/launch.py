@@ -74,6 +74,12 @@ def make_parser() -> argparse.ArgumentParser:
     g.add_argument("--output-filename", dest="output_filename", default=None,
                    help="Directory for per-rank log files instead of "
                         "interleaved stdout.")
+    g.add_argument("--launcher", choices=("auto", "local", "jsrun"),
+                   default="auto",
+                   help="Worker spawn mechanism: 'local' = ssh/local exec, "
+                        "'jsrun' = IBM LSF resource sets (reference "
+                        "js_run.py), 'auto' picks jsrun inside an LSF job "
+                        "when jsrun is installed.")
     g.add_argument("--disable-ssh-check", action="store_true",
                    dest="disable_ssh_check")
 
@@ -149,7 +155,51 @@ def _resolve_hosts(args) -> List[HostInfo]:
         return parse_hosts(args.hosts)
     if args.hostfile:
         return parse_hostfile(args.hostfile)
+    from .lsf import LSFUtils
+    if LSFUtils.using_lsf():
+        # Default hosts from the LSF allocation (reference launch.py uses
+        # lsf.LSFUtils the same way when -H/-hostfile are absent).
+        lsf_hosts = LSFUtils.get_compute_hosts()
+        if lsf_hosts:
+            return [HostInfo(h, n) for h, n in lsf_hosts]
     return [HostInfo("localhost", args.np or 1)]
+
+
+def _run_jsrun(args) -> int:
+    """Launch workers through IBM jsrun resource sets (reference:
+    runner/js_run.py:146). The rendezvous/coordinator live on the batch
+    host; per-task rank identity is translated from the PMIx env by the
+    ``horovod_tpu.runner.lsf`` shim each task execs through."""
+    import subprocess
+    from .lsf import make_jsrun_command
+
+    hosts = _resolve_hosts(args)
+    np = args.np or sum(h.slots for h in hosts)
+    rendezvous = RendezvousServer(verbose=args.verbose)
+    rendezvous.start()
+    slots, _size = get_host_assignments(hosts, np)
+    rendezvous.init(slots)
+    try:
+        base_env = config_parser.set_env_from_args(dict(os.environ), args)
+        # The JAX coordinator is BOUND by rank 0, which jsrun places on the
+        # first compute host — not on this batch host (same rule as
+        # _run_static's slots[0].hostname).
+        coord_host = slots[0].hostname if slots else socket.gethostname()
+        base_env["HVD_TPU_COORDINATOR_ADDR"] = \
+            f"{coord_host}:{free_port()}"
+        base_env["HVD_TPU_SIZE"] = str(np)
+        base_env["HVD_TPU_RENDEZVOUS_ADDR"] = socket.gethostname()
+        base_env["HVD_TPU_RENDEZVOUS_PORT"] = str(rendezvous.port)
+        cmd = make_jsrun_command(
+            [sys.executable, "-m", "horovod_tpu.runner.lsf", "--"]
+            + list(args.command),
+            base_env, num_proc=np, num_hosts=len(hosts))
+        if args.verbose:
+            sys.stderr.write("horovodrun-tpu: " + " ".join(cmd) + "\n")
+        proc = subprocess.run(cmd, env={**os.environ, **base_env})
+        return proc.returncode
+    finally:
+        rendezvous.stop()
 
 
 def _run_static(args) -> int:
@@ -210,6 +260,11 @@ def run_commandline(argv=None) -> int:
     random.seed()
     if args.host_discovery_script or (args.min_np is not None):
         return _run_elastic(args)
+    from .lsf import LSFUtils, is_jsrun_installed
+    if args.launcher == "jsrun" or (
+            args.launcher == "auto" and LSFUtils.using_lsf()
+            and is_jsrun_installed()):
+        return _run_jsrun(args)
     return _run_static(args)
 
 

@@ -35,13 +35,11 @@ def _require_pyspark():
         ) from e
 
 
-def run(fn: Callable, args=(), kwargs=None, num_proc: Optional[int] = None,
-        env: Optional[dict] = None, verbose: bool = False) -> List[Any]:
-    """Run ``fn(*args, **kwargs)`` as a distributed horovod_tpu job with
-    one worker per Spark executor; returns per-rank results ordered by rank
-    (reference: spark/runner.py:47-193).
-    """
-    _require_pyspark()
+def _run_barrier_stage(fn: Callable, args, kwargs, num_proc: int,
+                       extra_env: dict, verbose: bool) -> List[Any]:
+    """One barrier-mode Spark stage running ``fn`` on ``num_proc`` workers
+    (the body of ``run()``; also one elastic *generation* for
+    ``run_elastic``)."""
     from pyspark import BarrierTaskContext
     from pyspark.sql import SparkSession
 
@@ -51,8 +49,6 @@ def run(fn: Callable, args=(), kwargs=None, num_proc: Optional[int] = None,
 
     spark = SparkSession.builder.getOrCreate()
     sc = spark.sparkContext
-    if num_proc is None:
-        num_proc = max(int(sc.defaultParallelism), 1)
 
     driver_host = socket.gethostname()
     server = RendezvousServer(verbose=verbose)
@@ -65,7 +61,7 @@ def run(fn: Callable, args=(), kwargs=None, num_proc: Optional[int] = None,
     coordinator_port = free_port()
     payload = _dumps((fn, tuple(args), kwargs or {}))
     server.put("run_func", "func", payload)
-    extra_env = dict(env or {})
+    extra_env = dict(extra_env)
 
     def task(_):
         ctx = BarrierTaskContext.get()
@@ -108,22 +104,113 @@ def run(fn: Callable, args=(), kwargs=None, num_proc: Optional[int] = None,
     return [r for _, r in sorted(results)]
 
 
+def run(fn: Callable, args=(), kwargs=None, num_proc: Optional[int] = None,
+        env: Optional[dict] = None, verbose: bool = False) -> List[Any]:
+    """Run ``fn(*args, **kwargs)`` as a distributed horovod_tpu job with
+    one worker per Spark executor; returns per-rank results ordered by rank
+    (reference: spark/runner.py:47-193).
+    """
+    _require_pyspark()
+    if num_proc is None:
+        from pyspark.sql import SparkSession
+        sc = SparkSession.builder.getOrCreate().sparkContext
+        num_proc = max(int(sc.defaultParallelism), 1)
+    return _run_barrier_stage(fn, args, kwargs, num_proc, dict(env or {}),
+                              verbose)
+
+
+def _spark_available_parallelism() -> int:
+    from pyspark.sql import SparkSession
+    sc = SparkSession.builder.getOrCreate().sparkContext
+    # live executor cores; defaultParallelism tracks registered executors
+    # on dynamic-allocation clusters, so a dead executor shrinks the next
+    # generation (the Spark analogue of the discovery script's host list)
+    return max(int(sc.defaultParallelism), 1)
+
+
 def run_elastic(fn: Callable, args=(), kwargs=None,
                 num_proc: Optional[int] = None, min_np: Optional[int] = None,
-                max_np: Optional[int] = None, **launch_kwargs) -> List[Any]:
-    """Elastic variant (reference: spark/runner.py:303+). Spark re-executes
-    failed barrier stages; within a stage, worker failures follow the
-    elastic State protocol of :mod:`horovod_tpu.elastic`."""
-    _require_pyspark()
-    if min_np is not None or max_np is not None:
-        import logging
-        logging.getLogger("horovod_tpu").warning(
-            "horovod_tpu.spark.run_elastic: min_np/max_np are advisory in "
-            "this release — membership changes are handled by Spark's "
-            "barrier-stage retry at the requested num_proc, not by "
-            "in-flight resizing. Use the horovodrun-tpu elastic launcher "
-            "for true world resizing.")
-    # elastic-on-spark reuses the static launch path; Spark's stage retry is
-    # the outer membership mechanism
-    return run(fn, args=args, kwargs=kwargs, num_proc=num_proc,
-               **launch_kwargs)
+                max_np: Optional[int] = None, reset_limit: int = 3,
+                env: Optional[dict] = None, verbose: bool = False,
+                state_dir: Optional[str] = None,
+                _submit_attempt: Optional[Callable] = None,
+                _available_parallelism: Optional[Callable] = None
+                ) -> List[Any]:
+    """Elastic training on Spark (reference: spark/runner.py:303+
+    ``run_elastic``), redesigned around Spark's failure unit.
+
+    Spark barrier stages are all-or-nothing: when one barrier task dies the
+    whole stage is torn down. So a *stage attempt = one elastic
+    generation*, and the elastic loop lives on the driver:
+
+    1. every attempt sizes the world from current executor liveness,
+       clamped to [min_np, max_np] (the reference's host-discovery role);
+    2. workers run with the durable-commit contract of
+       :mod:`horovod_tpu.elastic` (``HVD_TPU_ELASTIC_STATE_DIR`` + job id):
+       every ``state.commit()`` persists, and a retried generation's
+       workers restore the last commit before ``state.sync()`` — exactly
+       the rank-kill recovery path of the ``horovodrun-tpu`` launcher,
+       with Spark's scheduler playing the respawner;
+    3. a failed attempt (barrier task death, executor loss) is retried up
+       to ``reset_limit`` times (reference: --reset-limit semantics).
+
+    ``state_dir`` must point at storage reachable by re-scheduled tasks
+    (any path in local mode; shared storage on a cluster). ``fn`` should
+    drive its loop through an ``hvd.elastic.State`` and ``commit()``; a
+    plain fn still works but restarts from scratch on retry.
+
+    ``_submit_attempt(num_proc, attempt_env)``/``_available_parallelism()``
+    are dependency-injection points for the pyspark-free unit tests (and
+    would allow other barrier schedulers to reuse the loop).
+    """
+    if _submit_attempt is None:
+        _require_pyspark()
+        submit = lambda n, e: _run_barrier_stage(  # noqa: E731
+            fn, args, kwargs, n, e, verbose)
+        avail = _available_parallelism or _spark_available_parallelism
+    else:
+        submit = _submit_attempt
+        avail = _available_parallelism or (lambda: num_proc or 1)
+
+    import logging
+    import tempfile
+    import uuid
+    log = logging.getLogger("horovod_tpu.spark")
+
+    min_np = int(min_np or 1)
+    own_state_dir = None
+    if state_dir is None:
+        state_dir = own_state_dir = tempfile.mkdtemp(
+            prefix="hvd_tpu_spark_elastic_")
+    job_id = uuid.uuid4().hex[:12]
+    base_env = dict(env or {})
+    base_env["HVD_TPU_ELASTIC_STATE_DIR"] = state_dir
+    base_env["HVD_TPU_ELASTIC_JOB_ID"] = job_id
+
+    last_error: Optional[BaseException] = None
+    try:
+        for attempt in range(reset_limit + 1):
+            live = int(avail())
+            n = num_proc if attempt == 0 and num_proc else live
+            if max_np:
+                n = min(n, int(max_np))
+            n = max(n, 1)
+            if n < min_np:
+                raise RuntimeError(
+                    f"elastic job needs at least {min_np} workers but only "
+                    f"{n} are available (attempt {attempt})")
+            if attempt:
+                log.warning(
+                    "spark elastic: generation %d failed (%s); retrying "
+                    "with %d workers", attempt - 1, last_error, n)
+            try:
+                return submit(n, dict(base_env))
+            except Exception as e:  # noqa: BLE001 — stage/job abort
+                last_error = e
+        raise RuntimeError(
+            f"spark elastic job failed after {reset_limit + 1} "
+            f"generations") from last_error
+    finally:
+        if own_state_dir:
+            import shutil
+            shutil.rmtree(own_state_dir, ignore_errors=True)

@@ -4,9 +4,13 @@ plane.
 Reference surface: horovod/tensorflow (/root/reference/horovod/tensorflow/
 __init__.py — ``allreduce`` :52-131, ``DistributedGradientTape`` :465-518,
 ``broadcast_variables`` in functions.py) re-exported process queries, and
-the broadcast hook. TF tensors bridge through host numpy, the same staging
-pattern as :mod:`horovod_tpu.torch` (reference's CPU-staging fallback,
-torch/mpi_ops_v2.cc:92+): TF in this stack is CPU-resident while jax owns
+the broadcast hook. TF tensors bridge through **DLPack** in both
+directions — zero-copy for CPU-resident eager tensors
+(``np.from_dlpack`` on the TF tensor's ``__dlpack__``;
+``tf.experimental.dlpack.from_dlpack`` on results) — with host numpy as
+the fallback, the same staging contract as :mod:`horovod_tpu.torch`
+(reference adapters: tensorflow/mpi_ops.cc TFTensor; CPU staging
+torch/mpi_ops_v2.cc:92+). TF in this stack is CPU-resident while jax owns
 the TPU.
 
 Usage (reference's TF2 recipe)::
@@ -39,6 +43,34 @@ def _tf():
     return tf
 
 
+def _to_numpy(t) -> np.ndarray:
+    """tf tensor/Variable -> numpy. Zero-copy via DLPack when the tensor is
+    CPU-resident and exposes ``__dlpack__`` (TF >= 2.13); ``.numpy()``
+    otherwise (itself often copy-free for CPU eager tensors)."""
+    if isinstance(t, np.ndarray):
+        return t
+    src = getattr(t, "value", None)
+    src = src() if callable(src) else t   # Variables: read the live tensor
+    try:
+        return np.from_dlpack(src)
+    except Exception:
+        return src.numpy() if hasattr(src, "numpy") else np.asarray(src)
+
+
+def _from_result(out, dtype=None):
+    """jax result -> tf tensor: DLPack import (zero-copy for CPU-backed jax
+    arrays; the result buffer is exclusively ours once the collective
+    finished) with a numpy-copy fallback."""
+    tf = _tf()
+    try:
+        t = tf.experimental.dlpack.from_dlpack(out.__dlpack__())
+    except Exception:
+        t = tf.convert_to_tensor(np.asarray(out))
+    if dtype is not None and t.dtype != dtype:
+        t = tf.cast(t, dtype)
+    return t
+
+
 def allreduce(tensor, average=None, name: Optional[str] = None, op=None,
               prescale_factor: float = 1.0, postscale_factor: float = 1.0):
     """Allreduce of a tf.Tensor (reference: tensorflow/__init__.py:52-131).
@@ -48,40 +80,91 @@ def allreduce(tensor, average=None, name: Optional[str] = None, op=None,
         from ..sparse import SparseGradient, allreduce_sparse
         avg = op is None and (average is None or average) or op == Average
         out = allreduce_sparse(
-            SparseGradient(indices=tensor.indices.numpy(),
-                           values=tensor.values.numpy(),
+            SparseGradient(indices=_to_numpy(tensor.indices),
+                           values=_to_numpy(tensor.values),
                            dense_shape=tuple(tensor.dense_shape.numpy())),
             average=bool(avg), name=name)
         return tf.IndexedSlices(
-            values=tf.convert_to_tensor(np.asarray(out.values)),
-            indices=tf.convert_to_tensor(np.asarray(out.indices)),
+            values=_from_result(np.asarray(out.values)),
+            indices=_from_result(np.asarray(out.indices)),
             dense_shape=tensor.dense_shape)
-    out = _c.allreduce(tensor.numpy(), average=average, name=name, op=op,
+    out = _c.allreduce(_to_numpy(tensor), average=average, name=name, op=op,
                        prescale_factor=prescale_factor,
                        postscale_factor=postscale_factor)
-    return tf.convert_to_tensor(np.asarray(out), dtype=tensor.dtype)
+    return _from_result(out, tensor.dtype)
 
 
 def allgather(tensor, name: Optional[str] = None):
-    tf = _tf()
-    out = _c.allgather(tensor.numpy(), name=name)
-    return tf.convert_to_tensor(np.asarray(out), dtype=tensor.dtype)
+    out = _c.allgather(_to_numpy(tensor), name=name)
+    return _from_result(out, tensor.dtype)
 
 
 def broadcast(tensor, root_rank: int, name: Optional[str] = None):
-    tf = _tf()
-    out = _c.broadcast(tensor.numpy(), root_rank=root_rank, name=name)
-    return tf.convert_to_tensor(np.asarray(out), dtype=tensor.dtype)
+    out = _c.broadcast(_to_numpy(tensor), root_rank=root_rank, name=name)
+    return _from_result(out, tensor.dtype)
+
+
+def alltoall(tensor, splits=None, name: Optional[str] = None):
+    out = _c.alltoall(_to_numpy(tensor), splits=splits, name=name)
+    return _from_result(out, tensor.dtype)
+
+
+# async verbs (handles interchangeable with horovod_tpu.collectives)
+def allreduce_async(tensor, average=None, name: Optional[str] = None,
+                    op=None) -> int:
+    return _c.allreduce_async(_to_numpy(tensor), average=average, name=name,
+                              op=op)
+
+
+def allgather_async(tensor, name: Optional[str] = None) -> int:
+    return _c.allgather_async(_to_numpy(tensor), name=name)
+
+
+def broadcast_async(tensor, root_rank: int,
+                    name: Optional[str] = None) -> int:
+    return _c.broadcast_async(_to_numpy(tensor), root_rank=root_rank,
+                              name=name)
+
+
+def alltoall_async(tensor, splits=None, name: Optional[str] = None) -> int:
+    return _c.alltoall_async(_to_numpy(tensor), splits=splits, name=name)
+
+
+def synchronize(handle: int):
+    return _from_result(_c.synchronize(handle))
+
+
+poll = _c.poll
 
 
 def broadcast_variables(variables: List, root_rank: int = 0) -> None:
     """Assign every variable its root-rank value (reference:
     tensorflow/functions.py broadcast_variables). Order is the caller's
-    list order, identical across processes by construction."""
-    for i, v in enumerate(variables):
-        name = f"bcast.var.{i}.{v.name if hasattr(v, 'name') else i}"
-        out = _c.broadcast(v.numpy(), root_rank=root_rank, name=name)
-        v.assign(np.asarray(out))
+    list order, identical across processes by construction.
+
+    Fused: variables are bucketed to the fusion threshold and each bucket
+    rides ONE grouped broadcast dispatch — not one collective per variable
+    (reference fusion-buffer broadcasts, collective_operations.cc:37-81)."""
+    from .. import basics as _basics
+    from .. import config as _config
+    from ..fusion import plan_buckets
+    vars_ = list(variables)
+    if not vars_:
+        return
+    staged = [_to_numpy(v) for v in vars_]
+    try:
+        threshold = int(
+            _basics.world().config.get(_config.FUSION_THRESHOLD))
+    except Exception:
+        threshold = 64 * 1024 * 1024
+    buckets = plan_buckets(
+        [(a.shape, a.dtype) for a in staged], threshold)
+    for bi, idxs in enumerate(buckets):
+        outs = _c.grouped_broadcast(
+            [staged[i] for i in idxs], root_rank=root_rank,
+            name=f"bcast.vars.{bi}.{len(idxs)}")
+        for i, out in zip(idxs, outs):
+            vars_[i].assign(np.asarray(out))
 
 
 def broadcast_object(obj: Any, root_rank: int = 0,

@@ -105,6 +105,34 @@ def alltoall(tensor, splits=None, name: Optional[str] = None):
 
 _handle_meta: Dict[int, Any] = {}
 _handle_meta_lock = threading.Lock()
+_HANDLE_META_CAP = 4096
+
+
+def _remember_handle(h: int, dtype) -> int:
+    """Track a handle's torch dtype, reclaiming abandoned handles.
+
+    A caller that polls a handle and never synchronizes it would otherwise
+    grow this map (and the collective table) forever; past the cap, the
+    oldest done-but-unconsumed handles are released."""
+    with _handle_meta_lock:
+        _handle_meta[h] = dtype
+        if len(_handle_meta) > _HANDLE_META_CAP:
+            for old in list(_handle_meta):   # insertion order = oldest first
+                if old == h or len(_handle_meta) <= _HANDLE_META_CAP // 2:
+                    break
+                try:
+                    done = _c.poll(old)
+                except Exception:
+                    # already synchronized through the raw API; meta is stale
+                    _handle_meta.pop(old, None)
+                    continue
+                if done:
+                    try:
+                        _c.release(old)
+                    except Exception:
+                        pass
+                    _handle_meta.pop(old, None)
+    return h
 
 
 def allreduce_async(tensor, average=None, name: Optional[str] = None,
@@ -113,23 +141,22 @@ def allreduce_async(tensor, average=None, name: Optional[str] = None,
     h = _c.allreduce_async(_to_numpy(tensor), average=average, name=name,
                            op=op, prescale_factor=prescale_factor,
                            postscale_factor=postscale_factor)
-    with _handle_meta_lock:
-        _handle_meta[h] = tensor.dtype
-    return h
+    return _remember_handle(h, tensor.dtype)
 
 
 def allgather_async(tensor, name: Optional[str] = None) -> int:
     h = _c.allgather_async(_to_numpy(tensor), name=name)
-    with _handle_meta_lock:
-        _handle_meta[h] = tensor.dtype
-    return h
+    return _remember_handle(h, tensor.dtype)
 
 
 def broadcast_async(tensor, root_rank: int, name: Optional[str] = None) -> int:
     h = _c.broadcast_async(_to_numpy(tensor), root_rank=root_rank, name=name)
-    with _handle_meta_lock:
-        _handle_meta[h] = tensor.dtype
-    return h
+    return _remember_handle(h, tensor.dtype)
+
+
+def alltoall_async(tensor, splits=None, name: Optional[str] = None) -> int:
+    h = _c.alltoall_async(_to_numpy(tensor), splits=splits, name=name)
+    return _remember_handle(h, tensor.dtype)
 
 
 def synchronize(handle: int):
@@ -208,19 +235,31 @@ def broadcast_optimizer_state(optimizer, root_rank: int = 0) -> None:
 
 
 class _DistributedOptimizer:
-    """Wraps a torch optimizer: backward hooks fire async allreduces per
-    parameter; ``step()`` synchronizes and applies (reference:
-    torch/optimizer.py:100-186)."""
+    """Wraps a torch optimizer: backward hooks collect ready gradients into
+    fixed fusion buckets; each full bucket fires ONE grouped async
+    allreduce; ``step()`` synchronizes and applies.
+
+    Reference: torch/optimizer.py:100-186 (per-parameter hooks) fused
+    through the fusion buffer (collective_operations.cc:37-81). Here the
+    fusion is at *dispatch granularity*: a ResNet-scale model issues
+    ~total_grad_bytes/threshold grouped dispatches per step instead of one
+    per parameter. Buckets are planned once, from reverse parameter
+    registration order (later layers' gradients materialize first in
+    backward — torch DDP's bucketing heuristic), so every process forms
+    identical buckets without negotiation; a bucket fires as soon as all
+    its members' gradients have accumulated, preserving comm/compute
+    overlap."""
 
     def __init__(self, optimizer, named_parameters=None, op=_c.Average,
                  backward_passes_per_step: int = 1,
-                 compression=Compression.none):
+                 compression=Compression.none,
+                 fusion_threshold_bytes: Optional[int] = None):
         self._opt = optimizer
         self._op = op
         self._bpps = backward_passes_per_step
         self._compression = compression
+        self._fusion_threshold = fusion_threshold_bytes
         self._pass_count: Dict[int, int] = {}
-        self._handles: Dict[Any, int] = {}
         self._ctxs: Dict[Any, Any] = {}
         self._names: Dict[Any, str] = {}
         all_params = [p for group in optimizer.param_groups
@@ -242,6 +281,7 @@ class _DistributedOptimizer:
                      for gi, group in enumerate(optimizer.param_groups)
                      for pi, p in enumerate(group["params"])]
         seen = set()
+        hooked = []
         for name, p in named:
             if name in seen:
                 raise ValueError(
@@ -250,7 +290,41 @@ class _DistributedOptimizer:
             seen.add(name)
             if p.requires_grad:
                 self._names[p] = name
+                hooked.append(p)
                 p.register_post_accumulate_grad_hook(self._make_hook())
+        self._plan_buckets(hooked)
+
+    @staticmethod
+    def _np_sizing_dtype(p):
+        """numpy dtype of equal itemsize, for bucket size planning only."""
+        s = str(p.dtype).replace("torch.", "")
+        try:
+            return np.dtype(s)
+        except TypeError:   # bfloat16 & friends: width is what matters
+            return np.dtype(np.uint16) if "16" in s else np.dtype(np.float32)
+
+    def _plan_buckets(self, params) -> None:
+        from ..fusion import plan_buckets
+        ordered = list(reversed(params))   # approximate readiness order
+        buckets = plan_buckets(
+            [(tuple(p.shape), self._np_sizing_dtype(p)) for p in ordered],
+            self._threshold())
+        self._bucket_members = [[ordered[i] for i in b] for b in buckets]
+        self._bucket_of: Dict[int, int] = {
+            id(p): bi for bi, b in enumerate(self._bucket_members)
+            for p in b}
+        # per-step mutable state
+        self._bucket_ready: Dict[int, Dict[int, Any]] = {}
+        self._group_handles: list = []
+
+    def _threshold(self) -> int:
+        if self._fusion_threshold is not None:
+            return int(self._fusion_threshold)
+        try:
+            from .. import config as _config
+            return int(_basics.world().config.get(_config.FUSION_THRESHOLD))
+        except Exception:
+            return 64 * 1024 * 1024
 
     # hooks ------------------------------------------------------------------
     def _make_hook(self):
@@ -258,7 +332,10 @@ class _DistributedOptimizer:
             n = self._pass_count.get(id(p), 0) + 1
             self._pass_count[id(p)] = n
             if n >= self._bpps:
-                if p in self._handles:
+                bid = self._bucket_of[id(p)]
+                ready = self._bucket_ready.setdefault(bid, {})
+                if id(p) in ready or any(
+                        p is q for h, ps in self._group_handles for q in ps):
                     raise AssertionError(
                         "Gradients were computed more than "
                         "backward_passes_per_step times before call to "
@@ -273,24 +350,57 @@ class _DistributedOptimizer:
                 # compression hook); decompressed in synchronize()
                 compressed, ctx = self._compression.compress(grad)
                 self._ctxs[p] = ctx
-                self._handles[p] = _c.allreduce_async(
-                    compressed, op=self._op,
-                    name=f"grad.{self._names[p]}")
+                ready[id(p)] = compressed
+                if len(ready) == len(self._bucket_members[bid]):
+                    self._fire_bucket(bid)
         return hook
+
+    def _fire_bucket(self, bid: int) -> None:
+        import zlib
+        ready = self._bucket_ready.pop(bid, None)
+        if not ready:
+            return
+        members = [p for p in self._bucket_members[bid] if id(p) in ready]
+        vals = [ready[id(p)] for p in members]
+        # Stable name across steps (no step counter): the consistency
+        # check's response cache then validates each bucket once, not once
+        # per step. The MEMBER-NAME digest makes membership part of the
+        # collective identity: same-shaped parameters missing on different
+        # processes would otherwise fingerprint identically and silently
+        # reduce mismatched gradients together; with the digest the names
+        # differ and the consistency exchange fails loudly instead.
+        digest = zlib.crc32("|".join(
+            self._names[p] for p in members).encode()) & 0xFFFFFFFF
+        h = _c.grouped_allreduce_async(
+            vals, op=self._op,
+            name=f"grad.bucket.{bid}."
+                 f"{len(members)}of{len(self._bucket_members[bid])}"
+                 f".{digest:08x}")
+        self._group_handles.append((h, members))
 
     # torch optimizer protocol ----------------------------------------------
     def synchronize(self):
         import torch
+        # Flush partially-ready buckets (params whose peers produced no
+        # gradient this step, e.g. frozen or unused branches). The partial
+        # count is part of the collective name, so processes diverging in
+        # WHICH grads exist fail the consistency check loudly rather than
+        # mispairing buckets.
+        for bid in sorted(self._bucket_ready):
+            self._fire_bucket(bid)
         if _basics.size() > 1:
             # round marker for cooperative Join (uneven data): joined ranks
             # pair this with their replay loop (collectives.join_round)
             _c.join_round()
-        for p, h in list(self._handles.items()):
-            out = _synchronize_handle(h)
-            out = self._compression.decompress(out, self._ctxs.pop(p, None))
-            with torch.no_grad():
-                p.grad.copy_(_from_numpy(out, p.grad.dtype))
-        self._handles.clear()
+        for h, members in self._group_handles:
+            outs = _synchronize_handle(h)
+            for p, out in zip(members, outs):
+                out = self._compression.decompress(
+                    out, self._ctxs.pop(p, None))
+                with torch.no_grad():
+                    p.grad.copy_(_from_numpy(out, p.grad.dtype))
+        self._group_handles = []
+        self._bucket_ready = {}
 
     def step(self, closure=None):
         self.synchronize()
@@ -319,11 +429,13 @@ class _DistributedOptimizer:
 
 def DistributedOptimizer(optimizer, named_parameters=None, op=_c.Average,
                          backward_passes_per_step: int = 1,
-                         compression=Compression.none):
+                         compression=Compression.none,
+                         fusion_threshold_bytes: Optional[int] = None):
     return _DistributedOptimizer(
         optimizer, named_parameters=named_parameters, op=op,
         backward_passes_per_step=backward_passes_per_step,
-        compression=compression)
+        compression=compression,
+        fusion_threshold_bytes=fusion_threshold_bytes)
 
 
 def __getattr__(name):  # PEP 562 lazy exports (torch import stays deferred)

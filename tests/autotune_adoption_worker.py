@@ -1,0 +1,67 @@
+"""Worker for the cross-process autotune-adoption test.
+
+Two processes feed the tuners rank-dependent measurements (so their LOCAL
+optima differ) and print what they adopted; the harness asserts both
+printed the same values — i.e. rank 0's choice was broadcast and adopted
+everywhere (reference: SynchronizeParameters, controller.cc:33-47).
+"""
+
+import os
+import sys
+import time
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ.pop("XLA_FLAGS", None)
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+import horovod_tpu as hvd  # noqa: E402
+
+
+def main():
+    hvd.init(config_overrides={
+        "AUTOTUNE": True,
+        "AUTOTUNE_WARMUP_SAMPLES": 1,
+        "AUTOTUNE_STEPS_PER_SAMPLE": 2,
+        "AUTOTUNE_BAYES_OPT_MAX_SAMPLES": 3,
+    })
+    rank = hvd.rank()
+    from horovod_tpu import basics
+    pm = basics.world().parameter_manager
+
+    # Eager-plane threshold: rank-dependent timings => divergent local
+    # scores; the per-sample broadcast must still converge both processes
+    # to one threshold.
+    step = 0
+    while pm.active:
+        pm.record(1 << 20, 0.01 * (1 + rank) + 0.001 * step)
+        step += 1
+        if step > 100:
+            raise AssertionError("tuner did not converge")
+    print(f"THRESHOLD={pm.fusion_threshold}", flush=True)
+
+    # Compiled-plane variant choice: rank 0 measures "b" faster, rank 1
+    # measures "a" faster; both must adopt rank 0's "b".
+    from horovod_tpu.compiled_autotune import autotune_variants
+
+    def variant_a():
+        time.sleep(0.05 if rank == 0 else 0.0)
+        return np.zeros(1)
+
+    def variant_b():
+        time.sleep(0.0 if rank == 0 else 0.05)
+        return np.zeros(1)
+
+    chosen, _fn, _times = autotune_variants(
+        {"a": variant_a, "b": variant_b}, warmup=0, iters=1, key="adoption")
+    print(f"VARIANT={chosen}", flush=True)
+    hvd.shutdown()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

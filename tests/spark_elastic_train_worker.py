@@ -1,0 +1,65 @@
+"""Worker for the spark run_elastic simulation test.
+
+Plays one barrier task of a generation: trains with per-epoch durable
+commits (the spark elastic contract — horovod_tpu/spark/__init__.py
+run_elastic), killing itself once at a configured epoch to simulate a
+barrier-task death. A retried generation's worker restores the committed
+epoch from HVD_TPU_ELASTIC_STATE_DIR and finishes.
+"""
+
+import os
+import sys
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ.pop("XLA_FLAGS", None)
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+import horovod_tpu as hvd  # noqa: E402
+
+SIM_DIR = os.environ["SPARK_SIM_DIR"]
+EPOCHS = int(os.environ.get("SPARK_SIM_EPOCHS", "4"))
+KILL_RANK = int(os.environ.get("SPARK_SIM_KILL_RANK", "-1"))
+KILL_EPOCH = int(os.environ.get("SPARK_SIM_KILL_EPOCH", "-1"))
+KILL_MARKER = os.path.join(SIM_DIR, "killed.marker")
+LOG = os.path.join(SIM_DIR, "events.log")
+
+
+def log_event(msg):
+    with open(LOG, "a") as f:
+        f.write(msg + "\n")
+
+
+def main():
+    hvd.init()
+    from horovod_tpu.elastic.run import maybe_load_persisted_state
+    state = hvd.elastic.ObjectState(epoch=0, total=0.0)
+    restored = maybe_load_persisted_state(state)
+    if restored:
+        log_event(f"restored rank={hvd.rank()} epoch={state.epoch}")
+    state.sync()
+    while state.epoch < EPOCHS:
+        out = hvd.allreduce(np.ones(4, np.float32), op=hvd.Sum,
+                            name=f"grad.{state.epoch % 2}")
+        if (hvd.rank() == KILL_RANK and state.epoch == KILL_EPOCH
+                and not os.path.exists(KILL_MARKER)):
+            open(KILL_MARKER, "w").close()
+            log_event(f"killed rank={hvd.rank()} epoch={state.epoch}")
+            os._exit(17)
+        state.total += float(np.asarray(out)[0])
+        state.epoch += 1
+        log_event(f"epoch={state.epoch} rank={hvd.rank()} "
+                  f"size={hvd.size()}")
+        state.commit()
+    log_event(f"done rank={hvd.rank()} size={hvd.size()} "
+              f"epochs={state.epoch} total={state.total}")
+    hvd.shutdown()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

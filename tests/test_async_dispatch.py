@@ -66,7 +66,9 @@ def test_torch_backward_overlaps_comm(hvd_world):
         # hooks fire async allreduces; backward must complete while the
         # dispatcher is blocked => staging/dispatch is off the caller thread
         loss.backward()
-        assert len(opt._handles) == 2
+        # both params share one bucket => one grouped handle covering both
+        assert len(opt._group_handles) == 1
+        assert len(opt._group_handles[0][1]) == 2
     finally:
         release.set()
     opt.step()

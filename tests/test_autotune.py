@@ -107,3 +107,151 @@ def test_python_fallback_optimizer_refines_near_best():
 def test_no_parameter_manager_without_knob(hvd_world):
     from horovod_tpu import basics
     assert basics.world().parameter_manager is None
+
+
+# ---------------------------------------------------------------------------
+# round 3: compiled-plane autotune (reduce strategy x packing) + adoption
+# ---------------------------------------------------------------------------
+def _mesh_world():
+    if hvd.is_initialized():
+        hvd.shutdown()
+    hvd.init()
+
+
+def test_compiled_reduction_variants_numerically_equal():
+    """All four (strategy, packing) combos produce identical gradients on
+    an 8-device outer x inner mesh."""
+    import jax
+    import jax.numpy as jnp
+    from jax import shard_map
+    from jax.sharding import Mesh, PartitionSpec as P
+    import optax
+
+    _mesh_world()
+    devs = np.array(jax.devices()[:8]).reshape(4, 2)
+    mesh = Mesh(devs, ("outer", "inner"))
+    grads = {"w": np.arange(8 * 3, dtype=np.float32).reshape(8, 3),
+             "b": np.arange(8, dtype=np.float32).reshape(8, 1)}
+
+    results = {}
+    for strategy in ("hierarchical", "flat"):
+        for packing in ("per_leaf", "packed"):
+            opt = hvd.DistributedOptimizer(
+                optax.sgd(1.0), axis_name="outer", inner_axis="inner",
+                reduce_strategy=strategy, packing=packing)
+
+            def red(g):
+                return opt.reduce_gradients(g)
+
+            f = jax.jit(shard_map(
+                red, mesh=mesh,
+                in_specs=({"w": P(("outer", "inner")),
+                           "b": P(("outer", "inner"))},),
+                out_specs={"w": P(("outer", "inner")),
+                           "b": P(("outer", "inner"))}))
+            results[(strategy, packing)] = jax.tree_util.tree_map(
+                np.asarray, f(grads))
+
+    ref = results[("hierarchical", "per_leaf")]
+    for k, r in results.items():
+        np.testing.assert_allclose(r["w"], ref["w"], rtol=1e-6,
+                                   err_msg=str(k))
+        np.testing.assert_allclose(r["b"], ref["b"], rtol=1e-6,
+                                   err_msg=str(k))
+    hvd.shutdown()
+
+
+def test_autotune_variants_picks_fastest():
+    import time as _t
+    from horovod_tpu.compiled_autotune import autotune_variants
+
+    _mesh_world()
+
+    def slow():
+        _t.sleep(0.03)
+        return np.zeros(2)
+
+    def fast():
+        return np.zeros(2)
+
+    chosen, fn, times = autotune_variants(
+        {"slow": slow, "fast": fast}, warmup=0, iters=2, key="t.pick")
+    assert chosen == "fast"
+    assert times["slow"] > times["fast"]
+    assert fn is fast
+    hvd.shutdown()
+
+
+def test_tune_distributed_step_end_to_end():
+    """tune_distributed_step compiles all combos of a real sharded step and
+    returns a winner whose output matches every other variant."""
+    import jax
+    import jax.numpy as jnp
+    from jax import shard_map
+    from jax.sharding import Mesh, PartitionSpec as P
+    import optax
+
+    _mesh_world()
+    from horovod_tpu.compiled_autotune import tune_distributed_step
+
+    devs = np.array(jax.devices()[:8]).reshape(2, 4)
+    mesh = Mesh(devs, ("dp", "ici"))
+    g = np.arange(16, dtype=np.float32).reshape(8, 2)
+
+    def make_step(reduce_strategy, packing):
+        opt = hvd.DistributedOptimizer(
+            optax.sgd(1.0), axis_name="dp", inner_axis="ici",
+            reduce_strategy=reduce_strategy, packing=packing)
+        return jax.jit(shard_map(
+            lambda x: opt.reduce_gradients(x), mesh=mesh,
+            in_specs=P(("dp", "ici")), out_specs=P(("dp", "ici"))))
+
+    options, step = tune_distributed_step(make_step, (g,), warmup=1,
+                                          iters=2, key="t.step")
+    assert options["reduce_strategy"] in ("hierarchical", "flat")
+    assert options["packing"] in ("per_leaf", "packed")
+    out = np.asarray(step(g))
+    expect = np.asarray(make_step("hierarchical", "per_leaf")(g))
+    np.testing.assert_allclose(out, expect, rtol=1e-6)
+    hvd.shutdown()
+
+
+@pytest.mark.integration
+def test_autotune_cross_process_adoption():
+    """Two processes with rank-dependent measurements adopt ONE threshold
+    and ONE compiled variant (rank 0's) — the SynchronizeParameters
+    semantics the reference gets from controller.cc:33-47."""
+    import re
+    import socket
+    import subprocess
+    import sys as _sys
+
+    worker = os.path.join(os.path.dirname(__file__),
+                          "autotune_adoption_worker.py")
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    procs = []
+    for pid in range(2):
+        env = dict(os.environ)
+        env.pop("XLA_FLAGS", None)
+        env.pop("PALLAS_AXON_POOL_IPS", None)
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(worker)))
+        env.update({
+            "PYTHONPATH": repo + os.pathsep + env.get("PYTHONPATH", ""),
+            "JAX_PLATFORMS": "cpu",
+            "HVD_TPU_COORDINATOR_ADDR": f"127.0.0.1:{port}",
+            "HVD_TPU_SIZE": "2",
+            "HVD_TPU_RANK": str(pid),
+        })
+        procs.append(subprocess.Popen(
+            [_sys.executable, worker], env=env,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT))
+    outs = []
+    for p in procs:
+        out, _ = p.communicate(timeout=180)
+        outs.append(out.decode(errors="replace"))
+        assert p.returncode == 0, outs
+    got = [dict(re.findall(r"(THRESHOLD|VARIANT)=(\S+)", o)) for o in outs]
+    assert got[0]["THRESHOLD"] == got[1]["THRESHOLD"], got
+    assert got[0]["VARIANT"] == got[1]["VARIANT"] == "b", got

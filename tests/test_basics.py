@@ -62,3 +62,29 @@ def test_process_sets(hvd_world):
 
 def test_hostname(hvd_world):
     assert isinstance(hvd.hostname(), str) and hvd.hostname()
+
+
+def test_mxnet_bridge_surface_is_gated():
+    """The mxnet bridge exposes the full reference surface
+    (mxnet/__init__.py:37-107) and every entry point raises the clear
+    import-gate error in images without mxnet."""
+    import horovod_tpu.mxnet as hvd_mx
+    for fn_name, call in [
+            ("allreduce", lambda: hvd_mx.allreduce(None)),
+            ("grouped_allreduce", lambda: hvd_mx.grouped_allreduce([])),
+            ("allgather", lambda: hvd_mx.allgather(None)),
+            ("broadcast", lambda: hvd_mx.broadcast(None)),
+            ("alltoall", lambda: hvd_mx.alltoall(None)),
+            ("broadcast_parameters",
+             lambda: hvd_mx.broadcast_parameters({})),
+            ("DistributedOptimizer",
+             lambda: hvd_mx.DistributedOptimizer(None)),
+            ("DistributedTrainer",
+             lambda: hvd_mx.DistributedTrainer(None, "sgd")),
+    ]:
+        assert hasattr(hvd_mx, fn_name)
+        try:
+            import mxnet  # noqa: F401
+        except ImportError:
+            with pytest.raises(ImportError, match="mxnet"):
+                call()

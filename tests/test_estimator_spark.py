@@ -5,6 +5,8 @@ suites run tiny models in local mode, test_spark_keras.py); the Spark layer
 is import-gated, so without pyspark the contract is a clear error.
 """
 
+import os
+
 import numpy as np
 import pytest
 
@@ -90,3 +92,173 @@ class TestSparkGate:
         est = hvd.Estimator(MLP(features=(16,), num_classes=4))
         with pytest.raises(ValueError, match="fewer than"):
             est.fit(x, y, epochs=1, batch_size=64)
+
+
+# ---------------------------------------------------------------------------
+# round 3: real spark.run_elastic — generation loop, liveness sizing,
+# durable-state recovery (reference: spark/runner.py:303+)
+# ---------------------------------------------------------------------------
+class TestSparkElasticLoop:
+    """pyspark-free tests of the elastic generation loop via the
+    dependency-injection points (the loop is scheduler-agnostic)."""
+
+    def test_retries_and_env_stability(self):
+        from horovod_tpu.spark import run_elastic
+        attempts = []
+
+        def submit(n, env):
+            attempts.append((n, env["HVD_TPU_ELASTIC_JOB_ID"],
+                             env["HVD_TPU_ELASTIC_STATE_DIR"]))
+            if len(attempts) < 3:
+                raise RuntimeError("barrier task died")
+            return [f"rank{i}" for i in range(n)]
+
+        out = run_elastic(None, num_proc=2, min_np=1, reset_limit=3,
+                          _submit_attempt=submit,
+                          _available_parallelism=lambda: 2)
+        assert out == ["rank0", "rank1"]
+        assert len(attempts) == 3
+        # job id + state dir identical across generations => retried
+        # workers find the previous generation's commits
+        assert len({a[1] for a in attempts}) == 1
+        assert len({a[2] for a in attempts}) == 1
+
+    def test_shrinks_to_liveness(self):
+        from horovod_tpu.spark import run_elastic
+        sizes = []
+        live = {"n": 4}
+
+        def submit(n, env):
+            sizes.append(n)
+            if len(sizes) == 1:
+                live["n"] = 2          # an executor died with the stage
+                raise RuntimeError("executor lost")
+            return list(range(n))
+
+        out = run_elastic(None, num_proc=4, min_np=2, max_np=4,
+                          reset_limit=2, _submit_attempt=submit,
+                          _available_parallelism=lambda: live["n"])
+        assert sizes == [4, 2]
+        assert out == [0, 1]
+
+    def test_reset_limit_exceeded(self):
+        from horovod_tpu.spark import run_elastic
+
+        def submit(n, env):
+            raise RuntimeError("always fails")
+
+        with pytest.raises(RuntimeError, match="after 2 generations"):
+            run_elastic(None, num_proc=1, reset_limit=1,
+                        _submit_attempt=submit,
+                        _available_parallelism=lambda: 1)
+
+    def test_min_np_enforced(self):
+        from horovod_tpu.spark import run_elastic
+        with pytest.raises(RuntimeError, match="at least 3"):
+            run_elastic(None, min_np=3, reset_limit=0,
+                        _submit_attempt=lambda n, e: [],
+                        _available_parallelism=lambda: 1)
+
+
+@pytest.mark.integration
+def test_spark_elastic_kill_and_recover(tmp_path):
+    """End-to-end recovery through the run_elastic loop with REAL worker
+    processes standing in for barrier tasks: rank 1 dies mid-generation,
+    the next generation restores the committed epoch and finishes.
+    (With pyspark installed the same scenario runs under a local
+    SparkSession — test_spark_elastic_real below.)"""
+    import socket
+    import subprocess
+    import sys as _sys
+
+    from horovod_tpu.spark import run_elastic
+
+    worker = os.path.join(os.path.dirname(__file__),
+                          "spark_elastic_train_worker.py")
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(worker)))
+    sim_dir = str(tmp_path)
+
+    def submit(n, attempt_env):
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            port = s.getsockname()[1]
+        procs = []
+        for pid in range(n):
+            env = dict(os.environ)
+            env.pop("XLA_FLAGS", None)
+            env.pop("PALLAS_AXON_POOL_IPS", None)
+            env.update(attempt_env)
+            env.update({
+                "PYTHONPATH": repo + os.pathsep + env.get("PYTHONPATH", ""),
+                "JAX_PLATFORMS": "cpu",
+                "HVD_TPU_COORDINATOR_ADDR": f"127.0.0.1:{port}",
+                "HVD_TPU_SIZE": str(n),
+                "HVD_TPU_RANK": str(pid),
+                "HVD_TPU_HOSTNAME": "localhost",
+                "HVD_TPU_LOCAL_RANK": str(pid),
+                "HVD_TPU_HEARTBEAT_TIMEOUT_SECONDS": "10",
+                "SPARK_SIM_DIR": sim_dir,
+                "SPARK_SIM_EPOCHS": "4",
+                "SPARK_SIM_KILL_RANK": "1",
+                "SPARK_SIM_KILL_EPOCH": "1",
+            })
+            procs.append(subprocess.Popen(
+                [_sys.executable, worker], env=env,
+                stdout=subprocess.PIPE, stderr=subprocess.STDOUT))
+        outs = [p.communicate(timeout=240)[0].decode(errors="replace")
+                for p in procs]
+        if any(p.returncode != 0 for p in procs):
+            raise RuntimeError(
+                "barrier task failed: "
+                + " | ".join(o[-400:] for o in outs))
+        return list(range(n))
+
+    out = run_elastic(None, num_proc=2, min_np=1, reset_limit=2,
+                      state_dir=sim_dir, _submit_attempt=submit,
+                      _available_parallelism=lambda: 2)
+    assert out == [0, 1]
+    with open(os.path.join(sim_dir, "events.log")) as f:
+        events = [l.strip() for l in f if l.strip()]
+    assert any(e.startswith("killed rank=1 epoch=1") for e in events), events
+    # generation 2 restored the committed epoch (>= 1), not scratch
+    restored = [e for e in events if e.startswith("restored ")]
+    assert restored and all("epoch=0" not in e.split("rank=")[0]
+                            for e in restored), events
+    assert any("epoch=1" in e for e in restored), events
+    done = [e for e in events if e.startswith("done ")]
+    assert len(done) == 2 and all("epochs=4" in e for e in done), events
+
+
+def test_spark_elastic_real_kill_and_recover(tmp_path):
+    """The same scenario on an actual local SparkSession (skips without
+    pyspark — reference: test_elastic_spark_*.py)."""
+    pytest.importorskip("pyspark")
+    import horovod_tpu.spark as hvd_spark
+
+    sim_dir = str(tmp_path)
+
+    def train():
+        import os as _os
+        import numpy as _np
+        import horovod_tpu as _hvd
+        from horovod_tpu.elastic.run import maybe_load_persisted_state
+        state = _hvd.elastic.ObjectState(epoch=0)
+        maybe_load_persisted_state(state)
+        state.sync()
+        while state.epoch < 3:
+            _hvd.allreduce(_np.ones(2, _np.float32), op=_hvd.Sum,
+                           name="g")
+            marker = _os.path.join(_os.environ["SPARK_SIM_DIR"], "k")
+            if (_hvd.rank() == 1 and state.epoch == 1
+                    and not _os.path.exists(marker)):
+                open(marker, "w").close()
+                _os._exit(17)
+            state.epoch += 1
+            state.commit()
+        return state.epoch
+
+    out = hvd_spark.run_elastic(
+        train, num_proc=2, min_np=1, reset_limit=2, state_dir=sim_dir,
+        env={"SPARK_SIM_DIR": sim_dir, "JAX_PLATFORMS": "cpu",
+             "HVD_TPU_HEARTBEAT_TIMEOUT_SECONDS": "10"})
+    assert out == [3, 3]
