@@ -1,0 +1,99 @@
+"""Checkpoint / resume training (orbax-backed).
+
+The reference's pattern is rank-0 framework checkpoints in examples
+(pytorch_mnist.py) plus elastic in-memory State; horovod_tpu adds a real
+checkpoint subsystem (horovod_tpu.checkpoint: rank-0 writes + barrier,
+multi-host orbax coordination, sharding-aware restore). This example
+trains, "crashes", restores the latest step in a fresh world, and
+finishes — the resume recipe for preemptible TPU pools.
+
+  python jax_checkpoint_resume.py --ckpt-dir /tmp/ckpt_demo
+"""
+
+import argparse
+
+import os as _os
+import sys as _sys
+_sys.path.insert(0, _os.path.join(_os.path.dirname(_os.path.abspath(__file__)), ".."))
+if _os.environ.get("JAX_PLATFORMS"):
+    import jax as _jax
+    _jax.config.update("jax_platforms", _os.environ["JAX_PLATFORMS"])
+
+import shutil
+import tempfile
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+import horovod_tpu as hvd
+from horovod_tpu import checkpoint
+
+
+def make_step(opt):
+    def loss_fn(params, x, y):
+        pred = x @ params["w"] + params["b"]
+        return jnp.mean((pred - y) ** 2)
+
+    @jax.jit
+    def step(params, opt_state, x, y):
+        loss, grads = jax.value_and_grad(loss_fn)(params, x, y)
+        updates, opt_state = opt.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state, loss
+    return step
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--ckpt-dir", default=None)
+    ap.add_argument("--steps", type=int, default=20)
+    ap.add_argument("--crash-at", type=int, default=10)
+    args = ap.parse_args()
+    if not (0 < args.crash_at < args.steps):
+        ap.error(f"--crash-at must be in (0, --steps): got "
+                 f"{args.crash_at} vs {args.steps}")
+    ckpt_dir = args.ckpt_dir or tempfile.mkdtemp(prefix="hvd_tpu_ckpt_")
+
+    rng = np.random.RandomState(0)
+    x = rng.randn(256, 8).astype(np.float32)
+    true_w = rng.randn(8, 1).astype(np.float32)
+    y = x @ true_w + 0.01 * rng.randn(256, 1).astype(np.float32)
+
+    # ---- phase 1: train and "crash" after a checkpoint ---------------------
+    hvd.init()
+    opt = hvd.DistributedOptimizer(optax.sgd(0.1))
+    params = {"w": jnp.zeros((8, 1)), "b": jnp.zeros((1,))}
+    opt_state = opt.init(params)
+    step = make_step(opt)
+    for i in range(args.crash_at):
+        params, opt_state, loss = step(params, opt_state, x, y)
+        checkpoint.save(ckpt_dir, i, {"params": params, "step": i},
+                        force=True)
+    crash_loss = float(loss)
+    print(f"'crashing' at step {args.crash_at}, loss {crash_loss:.5f}, "
+          f"latest checkpoint = step {checkpoint.latest_step(ckpt_dir)}")
+    hvd.shutdown()
+
+    # ---- phase 2: fresh world resumes from the latest checkpoint -----------
+    hvd.init()
+    restored = checkpoint.restore(ckpt_dir)
+    start = int(np.asarray(restored["step"])) + 1
+    params = jax.tree_util.tree_map(jnp.asarray, restored["params"])
+    opt = hvd.DistributedOptimizer(optax.sgd(0.1))
+    opt_state = opt.init(params)
+    step = make_step(opt)
+    for i in range(start, args.steps):
+        params, opt_state, loss = step(params, opt_state, x, y)
+    final = float(loss)
+    print(f"resumed at step {start}, finished step {args.steps - 1}, "
+          f"loss {final:.5f}")
+    assert final < crash_loss, "resumed training must keep improving"
+    print("OK")
+    hvd.shutdown()
+    if args.ckpt_dir is None:
+        shutil.rmtree(ckpt_dir, ignore_errors=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -24,6 +24,9 @@ import horovod_tpu as hvd
 
 def main():
     p = argparse.ArgumentParser()
+    p.add_argument("--model", default="resnet50",
+                   choices=("resnet50", "resnet18"),
+                   help="benchmark model (reference --model knob)")
     p.add_argument("--batch-size", type=int, default=32,
                    help="batch size per chip")
     p.add_argument("--num-warmup-batches", type=int, default=10)
@@ -40,9 +43,10 @@ def main():
         batch_per_chip=args.batch_size,
         num_warmup_batches=args.num_warmup_batches,
         num_batches_per_iter=args.num_batches_per_iter,
-        num_iters=args.num_iters)
+        num_iters=args.num_iters,
+        model_name=args.model)
     if hvd.rank() == 0:
-        print(f"Model: resnet50, batch size {args.batch_size}/chip, "
+        print(f"Model: {args.model}, batch size {args.batch_size}/chip, "
               f"{r.num_chips} chips")
         print(f"Img/sec per chip: {r.images_per_sec_per_chip:.1f}")
         print(f"Total img/sec on {r.num_chips} chip(s): "

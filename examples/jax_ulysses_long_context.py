@@ -1,0 +1,94 @@
+"""Long-context attention with Ulysses sequence parallelism.
+
+Each device holds S/n of the sequence; one all-to-all re-shards to full
+sequence over a head subset, attention runs at full context, a second
+all-to-all restores sequence sharding (horovod_tpu.parallel.ulysses; the
+DeepSpeed-Ulysses design, PAPERS.md). The reference has no long-context
+story at all — this is SURVEY.md §5's "long-context/SP" capability.
+
+Validates the sharded result against single-device full attention, then
+times steps at a context length that per-device attention memory could
+not hold unsharded.
+
+  XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
+      python jax_ulysses_long_context.py --seq-len 2048
+"""
+
+import argparse
+import time
+
+import os as _os
+import sys as _sys
+_sys.path.insert(0, _os.path.join(_os.path.dirname(_os.path.abspath(__file__)), ".."))
+if _os.environ.get("JAX_PLATFORMS"):
+    import jax as _jax
+    _jax.config.update("jax_platforms", _os.environ["JAX_PLATFORMS"])
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import shard_map
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+import horovod_tpu as hvd
+from horovod_tpu.parallel.ulysses import ulysses_attention
+
+
+def reference_attention(q, k, v, causal=True):
+    B, S, H, D = q.shape
+    logits = jnp.einsum("bshd,bthd->bhst", q, k) / np.sqrt(D)
+    if causal:
+        mask = jnp.tril(jnp.ones((S, S), bool))
+        logits = jnp.where(mask[None, None], logits, -1e30)
+    return jnp.einsum("bhst,bthd->bshd", jax.nn.softmax(logits, axis=-1), v)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--seq-len", type=int, default=2048)
+    ap.add_argument("--heads", type=int, default=8)
+    ap.add_argument("--head-dim", type=int, default=32)
+    ap.add_argument("--iters", type=int, default=5)
+    args = ap.parse_args()
+
+    hvd.init()
+    devices = jax.devices()
+    n = len(devices)
+    if args.heads % n != 0:
+        raise SystemExit(f"--heads must be divisible by {n} devices")
+    mesh = Mesh(np.array(devices), ("sp",))
+    seq_sharded = NamedSharding(mesh, P(None, "sp"))
+
+    rng = jax.random.PRNGKey(0)
+    kq, kk, kv = jax.random.split(rng, 3)
+    shape = (1, args.seq_len, args.heads, args.head_dim)
+    q = jax.device_put(jax.random.normal(kq, shape, jnp.float32), seq_sharded)
+    k = jax.device_put(jax.random.normal(kk, shape, jnp.float32), seq_sharded)
+    v = jax.device_put(jax.random.normal(kv, shape, jnp.float32), seq_sharded)
+
+    f = jax.jit(shard_map(
+        lambda q_, k_, v_: ulysses_attention(q_, k_, v_, axis_name="sp"),
+        mesh=mesh, in_specs=(P(None, "sp"), P(None, "sp"), P(None, "sp")),
+        out_specs=P(None, "sp")))
+
+    out = np.asarray(f(q, k, v))
+    expect = np.asarray(reference_attention(jnp.asarray(np.asarray(q)),
+                                            jnp.asarray(np.asarray(k)),
+                                            jnp.asarray(np.asarray(v))))
+    np.testing.assert_allclose(out, expect, rtol=2e-3, atol=2e-3)
+    print(f"sequence-parallel attention matches full attention "
+          f"(S={args.seq_len}, {n}-way sequence sharding)")
+
+    jax.block_until_ready(f(q, k, v))
+    t0 = time.perf_counter()
+    for _ in range(args.iters):
+        jax.block_until_ready(f(q, k, v))
+    dt = (time.perf_counter() - t0) / args.iters
+    toks = args.seq_len / dt
+    print(f"{dt * 1e3:.2f} ms/step, {toks:,.0f} tokens/s "
+          f"at context {args.seq_len}")
+    hvd.shutdown()
+
+
+if __name__ == "__main__":
+    main()
