@@ -19,11 +19,12 @@ kill window, whatever that window is):
      interrupt a native XLA compile (Python only runs signal handlers
      between bytecodes), so in-process alarms around compilation are
      unreliable — a watchdog that kills a child process is not.
-  2. The worker runs a cheapest-first ladder (batch 32 with 2 warmup + 10
-     quick steps prints a number right after the first compile) and then
-     escalates (longer batch-32 measurement, batch 64, batch 128),
-     emitting an improved JSON line after every stage. Same-batch stages
-     share one compiled step (horovod_tpu.benchmark.synthetic_resnet50_ladder).
+  2. The worker runs a cheapest-first ladder: stage 0 (batch 32, 1 warmup
+     + 2 steps) prints a number seconds after the first compile, then
+     escalation (quick and reference-length batch-32 measurements, batch
+     64, batch 128) emits an improved JSON line after every stage.
+     Same-batch stages share one compiled step
+     (horovod_tpu.benchmark.synthetic_resnet50_ladder).
   3. The parent streams the worker's stdout, immediately relaying every
      JSON line, tracks the best value, enforces an overall wall-clock
      budget (HVD_TPU_BENCH_BUDGET, default 420 s) by killing the worker,
@@ -181,7 +182,11 @@ def worker_main(cpu: bool, batch_override=None):
         ]
     else:
         stages = [
-            # Stage 1: one compile, minimal steps — first JSON line ASAP.
+            # Stage 0: one compile, 3 steps — first JSON line lands seconds
+            # after compilation finishes, whatever the driver's window is.
+            dict(batch_per_chip=32, num_warmup_batches=1,
+                 num_batches_per_iter=2, num_iters=1),
+            # Stage 1: same compiled step, a quick honest measurement.
             dict(batch_per_chip=32, num_warmup_batches=2,
                  num_batches_per_iter=5, num_iters=2),
             # Stage 2: same compiled step, reference-length measurement.
@@ -197,10 +202,19 @@ def worker_main(cpu: bool, batch_override=None):
 
     best_v = -1.0
     it = synthetic_resnet50_ladder(stages)
+    prev_ok = False
     for i in range(len(stages)):
-        if i > 0 and time.time() > deadline - STAGE_MARGIN_S:
+        # A stage reusing the previous stage's batch size reuses its
+        # compiled step — only a fresh batch size pays a compile, so only
+        # it needs the full margin. A FAILED previous stage drops the rig
+        # (benchmark.py ladder semantics), so only a successful same-batch
+        # predecessor earns the small margin.
+        same_rig = prev_ok and i > 0 and (
+            stages[i]["batch_per_chip"] == stages[i - 1]["batch_per_chip"])
+        margin = 30.0 if same_rig else STAGE_MARGIN_S
+        if i > 0 and time.time() > deadline - margin:
             _log(f"worker: {deadline - time.time():.0f}s left < "
-                 f"{STAGE_MARGIN_S:.0f}s margin; stopping after stage {i}")
+                 f"{margin:.0f}s margin; stopping after stage {i}")
             break
         t0 = time.time()
         try:
@@ -210,9 +224,11 @@ def worker_main(cpu: bool, batch_override=None):
         if err is not None:
             # Per-stage failure (e.g. OOM at a larger batch); the ladder
             # stays alive for the remaining stages.
+            prev_ok = False
             _log(f"worker stage {i + 1} ({stages[i]}) failed: "
                  f"{type(err).__name__}: {err}"[:1500])
             continue
+        prev_ok = True
         _log(f"worker stage {i + 1}: batch={r.batch_per_chip} "
              f"{r.images_per_sec_per_chip:.1f} img/s/chip "
              f"in {time.time() - t0:.0f}s")

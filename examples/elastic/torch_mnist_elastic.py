@@ -1,0 +1,89 @@
+"""Elastic PyTorch training with TorchState.
+
+Counterpart of the reference's examples/elastic/pytorch_mnist_elastic.py:
+model + optimizer state live in a ``TorchState``; ``@hvd.elastic.run``
+supplies the retry loop; per-batch commits bound lost work.
+
+  horovodrun-tpu -np 2 --min-np 1 --max-np 4 \
+      --host-discovery-script ./discover_hosts.sh \
+      python torch_mnist_elastic.py
+Also runs standalone (world of one, no failures).
+"""
+
+import argparse
+
+import os as _os
+import sys as _sys
+_sys.path.insert(0, _os.path.join(_os.path.dirname(_os.path.abspath(__file__)), "../.."))
+if _os.environ.get("JAX_PLATFORMS"):
+    import jax as _jax
+    _jax.config.update("jax_platforms", _os.environ["JAX_PLATFORMS"])
+
+import numpy as np
+import torch
+import torch.nn.functional as F
+
+import horovod_tpu.torch as hvd
+
+
+def synthetic_mnist(n=2048, seed=0):
+    rng = np.random.RandomState(seed)
+    y = rng.randint(0, 10, size=n)
+    centers = rng.randn(10, 784).astype(np.float32)
+    x = centers[y] + 0.3 * rng.randn(n, 784).astype(np.float32)
+    return torch.from_numpy(x), torch.from_numpy(y)
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--epochs", type=int, default=2)
+    p.add_argument("--batch-size", type=int, default=128)
+    p.add_argument("--batches-per-commit", type=int, default=8)
+    args = p.parse_args()
+
+    hvd.init()
+    torch.manual_seed(0)
+    model = torch.nn.Sequential(
+        torch.nn.Linear(784, 128), torch.nn.ReLU(),
+        torch.nn.Linear(128, 10))
+    opt = hvd.DistributedOptimizer(
+        torch.optim.SGD(model.parameters(), lr=0.05 * hvd.size()),
+        named_parameters=model.named_parameters())
+
+    x, y = synthetic_mnist()
+    x, y = x[hvd.rank()::hvd.size()], y[hvd.rank()::hvd.size()]
+
+    state = hvd.elastic.TorchState(model=model, optimizer=opt,
+                                   epoch=0, batch=0)
+
+    @hvd.elastic.run
+    def train(state):
+        while state.epoch < args.epochs:
+            nb = len(x) // args.batch_size
+            while state.batch < nb:
+                i = state.batch * args.batch_size
+                xb, yb = x[i:i + args.batch_size], y[i:i + args.batch_size]
+                opt.zero_grad()
+                loss = F.cross_entropy(model(xb), yb)
+                loss.backward()
+                opt.step()
+                state.batch += 1
+                if state.batch % args.batches_per_commit == 0:
+                    state.commit()
+            if hvd.rank() == 0:
+                print(f"epoch {state.epoch}: loss {loss.item():.4f} "
+                      f"(world size {hvd.size()})")
+            state.batch = 0
+            state.epoch += 1
+            state.commit()
+
+    train(state)
+    with torch.no_grad():
+        acc = (model(x).argmax(-1) == y).float().mean().item()
+    print(f"rank {hvd.rank()}: final train accuracy {acc:.3f}")
+    assert acc > 0.5
+    hvd.shutdown()
+
+
+if __name__ == "__main__":
+    main()

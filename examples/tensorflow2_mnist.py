@@ -1,0 +1,80 @@
+"""TF2 eager training with DistributedGradientTape.
+
+Counterpart of the reference's examples/tensorflow2_mnist.py: the
+non-Keras TF2 recipe — wrap the tape, reduce gradients, broadcast
+variables after the first step.
+
+  python tensorflow2_mnist.py --steps 50
+"""
+
+import argparse
+
+import os as _os
+import sys as _sys
+_sys.path.insert(0, _os.path.join(_os.path.dirname(_os.path.abspath(__file__)), ".."))
+if _os.environ.get("JAX_PLATFORMS"):
+    import jax as _jax
+    _jax.config.update("jax_platforms", _os.environ["JAX_PLATFORMS"])
+
+import numpy as np
+
+import horovod_tpu.tensorflow as hvd
+
+
+def synthetic_mnist(n=2048, seed=0):
+    rng = np.random.RandomState(seed)
+    y = rng.randint(0, 10, size=n)
+    centers = rng.randn(10, 784).astype(np.float32)
+    x = centers[y] + 0.3 * rng.randn(n, 784).astype(np.float32)
+    return x, y.astype(np.int64)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=50)
+    ap.add_argument("--batch-size", type=int, default=128)
+    ap.add_argument("--lr", type=float, default=1e-3)
+    args = ap.parse_args()
+
+    import tensorflow as tf
+    import keras
+
+    hvd.init()
+    x, y = synthetic_mnist()
+    x = x[hvd.rank()::hvd.size()]
+    y = y[hvd.rank()::hvd.size()]
+
+    model = keras.Sequential([
+        keras.layers.Input(shape=(784,)),
+        keras.layers.Dense(128, activation="relu"),
+        keras.layers.Dense(10),
+    ])
+    opt = keras.optimizers.Adam(args.lr * hvd.size())
+    loss_obj = keras.losses.SparseCategoricalCrossentropy(from_logits=True)
+
+    nb = len(x) // args.batch_size
+    for step in range(args.steps):
+        i = (step % nb) * args.batch_size
+        xb = tf.convert_to_tensor(x[i:i + args.batch_size])
+        yb = tf.convert_to_tensor(y[i:i + args.batch_size])
+        with tf.GradientTape() as tape:
+            loss = loss_obj(yb, model(xb, training=True))
+        tape = hvd.DistributedGradientTape(tape)
+        grads = tape.gradient(loss, model.trainable_variables)
+        opt.apply_gradients(zip(grads, model.trainable_variables))
+        if step == 0:
+            # after the first apply so optimizer slots exist everywhere
+            hvd.broadcast_variables(model.variables, root_rank=0)
+            hvd.broadcast_variables(opt.variables, root_rank=0)
+        if step % 10 == 0 and hvd.rank() == 0:
+            print(f"step {step}: loss {float(loss):.4f}")
+
+    logits = model(tf.convert_to_tensor(x))
+    acc = float(np.mean(np.argmax(logits.numpy(), -1) == y))
+    print(f"rank {hvd.rank()}: final train accuracy {acc:.3f}")
+    assert acc > 0.5
+    hvd.shutdown()
+
+
+if __name__ == "__main__":
+    main()

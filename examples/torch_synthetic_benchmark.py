@@ -1,0 +1,94 @@
+"""PyTorch synthetic benchmark through the TPU collective plane.
+
+Counterpart of the reference's examples/pytorch_synthetic_benchmark.py
+(torchvision ResNet-50 + hvd.DistributedOptimizer, timed img/sec): a
+self-contained conv net (no torchvision dependency), gradients reduced by
+the bucketed torch bridge, reporting img/sec per worker and total.
+
+  python torch_synthetic_benchmark.py --num-iters 3
+"""
+
+import argparse
+import time
+
+import os as _os
+import sys as _sys
+_sys.path.insert(0, _os.path.join(_os.path.dirname(_os.path.abspath(__file__)), ".."))
+if _os.environ.get("JAX_PLATFORMS"):
+    import jax as _jax
+    _jax.config.update("jax_platforms", _os.environ["JAX_PLATFORMS"])
+
+import numpy as np
+import torch
+import torch.nn.functional as F
+
+import horovod_tpu.torch as hvd
+
+
+class SmallConvNet(torch.nn.Module):
+    def __init__(self, num_classes=1000):
+        super().__init__()
+        self.conv1 = torch.nn.Conv2d(3, 32, 3, stride=2, padding=1)
+        self.conv2 = torch.nn.Conv2d(32, 64, 3, stride=2, padding=1)
+        self.conv3 = torch.nn.Conv2d(64, 128, 3, stride=2, padding=1)
+        self.fc = torch.nn.Linear(128, num_classes)
+
+    def forward(self, x):
+        x = F.relu(self.conv1(x))
+        x = F.relu(self.conv2(x))
+        x = F.relu(self.conv3(x))
+        x = x.mean(dim=(2, 3))
+        return self.fc(x)
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--batch-size", type=int, default=32)
+    p.add_argument("--image-size", type=int, default=64)
+    p.add_argument("--num-warmup-batches", type=int, default=2)
+    p.add_argument("--num-batches-per-iter", type=int, default=3)
+    p.add_argument("--num-iters", type=int, default=5)
+    p.add_argument("--fp16-allreduce", action="store_true")
+    args = p.parse_args()
+
+    hvd.init()
+    torch.manual_seed(0)
+    model = SmallConvNet()
+    compression = (hvd.Compression.fp16 if args.fp16_allreduce
+                   else hvd.Compression.none)
+    opt = hvd.DistributedOptimizer(
+        torch.optim.SGD(model.parameters(), lr=0.01 * hvd.size()),
+        named_parameters=model.named_parameters(),
+        compression=compression)
+    hvd.broadcast_parameters(model.state_dict(), root_rank=0)
+
+    data = torch.randn(args.batch_size, 3, args.image_size, args.image_size)
+    target = torch.randint(0, 1000, (args.batch_size,))
+
+    def run_batches(k):
+        for _ in range(k):
+            opt.zero_grad()
+            loss = F.cross_entropy(model(data), target)
+            loss.backward()
+            opt.step()
+
+    run_batches(args.num_warmup_batches)
+    img_secs = []
+    for i in range(args.num_iters):
+        t0 = time.time()
+        run_batches(args.num_batches_per_iter)
+        ips = args.batch_size * args.num_batches_per_iter / (time.time() - t0)
+        if hvd.rank() == 0:
+            print(f"Iter #{i}: {ips:.1f} img/sec per worker")
+        img_secs.append(ips)
+
+    if hvd.rank() == 0:
+        mean = np.mean(img_secs)
+        print(f"Img/sec per worker: {mean:.1f} +- {1.96 * np.std(img_secs):.1f}")
+        print(f"Total img/sec on {hvd.size()} worker(s): "
+              f"{hvd.size() * mean:.1f}")
+    hvd.shutdown()
+
+
+if __name__ == "__main__":
+    main()

@@ -43,6 +43,9 @@ def _run_example(script, *args, timeout=420, devices=8):
     ("tf2_keras_mnist.py", ("--epochs", "1")),
     ("torch_mnist.py", ("--epochs", "1")),
     ("adasum_small_model.py", ()),
+    ("torch_synthetic_benchmark.py", ("--num-iters", "2")),
+    ("tensorflow2_mnist.py", ("--steps", "30")),
+    ("elastic/torch_mnist_elastic.py", ("--epochs", "1")),
 ])
 def test_example_runs(script, args):
     _run_example(script, *args)
