@@ -104,7 +104,11 @@ def probe_backend():
     """
     last_err = ""
     for attempt in range(1, PROBE_ATTEMPTS + 1):
-        timeout = min(PROBE_TIMEOUT_S, max(10, _remaining() - 60))
+        # First attempt gets the full window; retries get a short one — a
+        # healthy backend answers in seconds, and two full-length hanging
+        # probes would eat the budget the CPU fallback needs.
+        cap = PROBE_TIMEOUT_S if attempt == 1 else min(PROBE_TIMEOUT_S, 45)
+        timeout = min(cap, max(10, _remaining() - 60))
         t0 = time.time()
         try:
             p = subprocess.run(
@@ -189,15 +193,17 @@ def worker_main(cpu: bool, batch_override=None):
             # Stage 1: same compiled step, a quick honest measurement.
             dict(batch_per_chip=32, num_warmup_batches=2,
                  num_batches_per_iter=5, num_iters=2),
-            # Stage 2: same compiled step, reference-length measurement.
+            # Stage 2: reference-length measurement with the SCANNED
+            # k-step program (one XLA call per timed iteration — no
+            # per-step host dispatch in the measurement).
             dict(batch_per_chip=32, num_warmup_batches=5,
-                 num_batches_per_iter=10, num_iters=10),
+                 num_batches_per_iter=10, num_iters=10, scanned=True),
             # Stages 3-4: larger batches for throughput/MFU, re-printing
             # improved lines. Each costs a fresh compile.
             dict(batch_per_chip=64, num_warmup_batches=5,
-                 num_batches_per_iter=10, num_iters=10),
+                 num_batches_per_iter=10, num_iters=10, scanned=True),
             dict(batch_per_chip=128, num_warmup_batches=5,
-                 num_batches_per_iter=10, num_iters=10),
+                 num_batches_per_iter=10, num_iters=10, scanned=True),
         ]
 
     best_v = -1.0
@@ -205,12 +211,14 @@ def worker_main(cpu: bool, batch_override=None):
     prev_ok = False
     for i in range(len(stages)):
         # A stage reusing the previous stage's batch size reuses its
-        # compiled step — only a fresh batch size pays a compile, so only
-        # it needs the full margin. A FAILED previous stage drops the rig
-        # (benchmark.py ladder semantics), so only a successful same-batch
-        # predecessor earns the small margin.
+        # compiled step — only a fresh batch size (or a first scanned
+        # stage, which compiles the k-step program) pays a compile, so
+        # only those need the full margin. A FAILED previous stage drops
+        # the rig (benchmark.py ladder semantics), so only a successful
+        # same-shape predecessor earns the small margin.
         same_rig = prev_ok and i > 0 and (
-            stages[i]["batch_per_chip"] == stages[i - 1]["batch_per_chip"])
+            stages[i]["batch_per_chip"] == stages[i - 1]["batch_per_chip"]
+            and stages[i].get("scanned") == stages[i - 1].get("scanned"))
         margin = 30.0 if same_rig else STAGE_MARGIN_S
         if i > 0 and time.time() > deadline - margin:
             _log(f"worker: {deadline - time.time():.0f}s left < "

@@ -60,6 +60,7 @@ def main():
     def train(state):
         while state.epoch < args.epochs:
             nb = len(x) // args.batch_size
+            loss = None   # a restore can land exactly at state.batch == nb
             while state.batch < nb:
                 i = state.batch * args.batch_size
                 xb, yb = x[i:i + args.batch_size], y[i:i + args.batch_size]
@@ -70,7 +71,7 @@ def main():
                 state.batch += 1
                 if state.batch % args.batches_per_commit == 0:
                     state.commit()
-            if hvd.rank() == 0:
+            if hvd.rank() == 0 and loss is not None:
                 print(f"epoch {state.epoch}: loss {loss.item():.4f} "
                       f"(world size {hvd.size()})")
             state.batch = 0
