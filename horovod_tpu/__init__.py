@@ -35,11 +35,11 @@ from .basics import (  # noqa: F401
 )
 from .collectives import (  # noqa: F401
     ReduceOp, Average, Sum, Adasum, Min, Max, Product,
-    allreduce, allreduce_async, grouped_allreduce,
+    allreduce, allreduce_async, grouped_allreduce, grouped_allreduce_async,
     allgather, allgather_async,
-    broadcast, broadcast_async,
-    alltoall,
-    poll, synchronize, join, join_round, joined, barrier,
+    broadcast, broadcast_async, grouped_broadcast, grouped_broadcast_async,
+    alltoall, alltoall_async,
+    poll, synchronize, release, join, join_round, joined, barrier,
 )
 from .exceptions import (  # noqa: F401
     HorovodInternalError, HostsUpdatedInterrupt, TensorValidationError,

@@ -58,6 +58,11 @@ def make_parser() -> argparse.ArgumentParser:
         prog="horovodrun-tpu",
         description="Launch a horovod_tpu distributed job.")
     p.add_argument("-v", "--version", action="store_true")
+    p.add_argument("-cb", "--check-build", action="store_true",
+                   dest="check_build",
+                   help="Print the availability matrix (frameworks, "
+                        "native core, data plane) and exit — reference "
+                        "`horovodrun --check-build` (launch.py:110).")
     p.add_argument("-np", "--num-proc", type=int, dest="np", default=None,
                    help="Total number of worker processes (default: one per "
                         "host; TPU chips are addressed via meshes, not "
@@ -146,6 +151,48 @@ def parse_args(argv=None) -> argparse.Namespace:
     if args.command and args.command[0] == "--":
         args.command = args.command[1:]
     return args
+
+
+def check_build() -> str:
+    """Availability matrix (reference: launch.py:110 check_build). The
+    reference reports which comm libraries were compiled in; here the data
+    plane is always XLA, so the interesting axes are framework bridges,
+    the native C++ core, and accelerator reachability."""
+    import importlib.util
+    import shutil
+
+    def have(mod: str) -> str:
+        return "X" if importlib.util.find_spec(mod) is not None else " "
+
+    from .. import __version__
+    from .._native import get as native_get
+    try:
+        import jax
+        backends = ",".join(sorted({d.platform for d in jax.devices()}))
+    except Exception:
+        backends = "unavailable"
+    native = "X" if native_get() is not None else " "
+    return f"""\
+horovod_tpu v{__version__}:
+
+Available Frameworks:
+    [X] JAX / Flax (native plane)
+    [{have('torch')}] PyTorch
+    [{have('tensorflow')}] TensorFlow
+    [{have('keras')}] Keras
+    [{have('mxnet')}] MXNet
+    [{have('pyspark')}] Spark
+
+Data Plane:
+    [X] XLA collectives (ICI/DCN)   devices: {backends}
+
+Native Core (C++):
+    [{native}] tensor table / fusion planner / response cache / wire
+    [{native}] timeline writer / stall tracker / GP-BO autotuner
+
+Launchers:
+    [X] local / ssh
+    [{'X' if shutil.which('jsrun') else ' '}] LSF jsrun"""
 
 
 def _resolve_hosts(args) -> List[HostInfo]:
@@ -253,6 +300,9 @@ def run_commandline(argv=None) -> int:
     if args.version:
         from .. import __version__
         print(__version__)
+        return 0
+    if args.check_build:
+        print(check_build())
         return 0
     if not args.command:
         make_parser().print_usage()

@@ -253,11 +253,28 @@ class _DistributedOptimizer:
     def __init__(self, optimizer, named_parameters=None, op=_c.Average,
                  backward_passes_per_step: int = 1,
                  compression=Compression.none,
+                 gradient_predivide_factor: float = 1.0,
                  fusion_threshold_bytes: Optional[int] = None):
+        if gradient_predivide_factor != 1.0 and op != _c.Average:
+            raise ValueError(
+                "gradient_predivide_factor only applies to op=Average "
+                "(reference: torch/optimizer.py:395-398)")
         self._opt = optimizer
         self._op = op
         self._bpps = backward_passes_per_step
         self._compression = compression
+        # Reference-parity knob (torch/__init__.py DistributedOptimizer):
+        # there the factor splits the averaging divide around the fp16
+        # summation to control overflow. Here the XLA plane folds
+        # prescale*postscale into one scalar and accumulates half dtypes
+        # in fp32 regardless (_combined_scale/_allreduce_impl), so the
+        # factor is accepted for API parity and is numerically neutral —
+        # the overflow problem it works around does not exist on this
+        # data plane.
+        self._prescale = 1.0 / gradient_predivide_factor \
+            if gradient_predivide_factor != 1.0 else 1.0
+        self._postscale = gradient_predivide_factor \
+            if gradient_predivide_factor != 1.0 else 1.0
         self._fusion_threshold = fusion_threshold_bytes
         self._pass_count: Dict[int, int] = {}
         self._ctxs: Dict[Any, Any] = {}
@@ -373,6 +390,8 @@ class _DistributedOptimizer:
             self._names[p] for p in members).encode()) & 0xFFFFFFFF
         h = _c.grouped_allreduce_async(
             vals, op=self._op,
+            prescale_factor=self._prescale,
+            postscale_factor=self._postscale,
             name=f"grad.bucket.{bid}."
                  f"{len(members)}of{len(self._bucket_members[bid])}"
                  f".{digest:08x}")
@@ -430,11 +449,13 @@ class _DistributedOptimizer:
 def DistributedOptimizer(optimizer, named_parameters=None, op=_c.Average,
                          backward_passes_per_step: int = 1,
                          compression=Compression.none,
+                         gradient_predivide_factor: float = 1.0,
                          fusion_threshold_bytes: Optional[int] = None):
     return _DistributedOptimizer(
         optimizer, named_parameters=named_parameters, op=op,
         backward_passes_per_step=backward_passes_per_step,
         compression=compression,
+        gradient_predivide_factor=gradient_predivide_factor,
         fusion_threshold_bytes=fusion_threshold_bytes)
 
 

@@ -18,7 +18,7 @@ def test_docs_exist_and_cover_reference_topics():
                   "elastic", "timeline", "autotune", "adasum",
                   "tensor-fusion", "pytorch", "tensorflow", "keras",
                   "mxnet", "spark", "lsf", "troubleshooting", "api",
-                  "install", "index"]:
+                  "install", "index", "inference"]:
         assert f"{topic}.md" in files, f"missing docs/{topic}.md"
 
 
@@ -40,8 +40,10 @@ def test_documented_top_level_api_exists():
     import horovod_tpu as hvd
     for name in ["init", "shutdown", "is_initialized", "rank", "size",
                  "local_rank", "dp_size", "allreduce", "allreduce_async",
-                 "grouped_allreduce", "allgather", "broadcast", "alltoall",
-                 "poll", "synchronize", "join", "barrier",
+                 "grouped_allreduce", "grouped_allreduce_async",
+                 "allgather", "broadcast", "grouped_broadcast",
+                 "grouped_broadcast_async", "alltoall", "alltoall_async",
+                 "poll", "synchronize", "release", "join", "barrier",
                  "DistributedOptimizer", "Average", "Sum", "Adasum",
                  "elastic", "checkpoint", "Estimator"]:
         assert hasattr(hvd, name), f"documented symbol hvd.{name} missing"

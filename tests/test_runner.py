@@ -387,3 +387,15 @@ class TestLSF:
         rc = launch.run_commandline(
             ["--launcher", "jsrun", "-np", "2", "--", "python", "x.py"])
         assert rc == 0 and "jsrun" in called
+
+
+def test_check_build_matrix(capsys):
+    """--check-build prints the availability matrix and exits 0
+    (reference: horovodrun --check-build, launch.py:110)."""
+    from horovod_tpu.runner import launch
+    rc = launch.run_commandline(["--check-build"])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "horovod_tpu v" in out
+    assert "JAX / Flax (native plane)" in out
+    assert "XLA collectives" in out

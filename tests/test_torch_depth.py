@@ -246,3 +246,32 @@ def test_handle_meta_eviction(hvd_world, monkeypatch):
                               op=hvd_t.Sum)
     hvd_t.synchronize(h)
     assert len(hvd_t._handle_meta) <= 8
+
+
+def test_gradient_predivide_factor(hvd_world):
+    """gradient_predivide_factor splits the averaging scale around the sum
+    (reference torch/__init__.py knob): numerics identical to plain
+    Average, and it is rejected for op=Sum."""
+    import horovod_tpu.torch as hvd_t
+
+    def fit(factor):
+        torch.manual_seed(5)
+        m = torch.nn.Linear(3, 2)
+        opt = hvd_t.DistributedOptimizer(
+            torch.optim.SGD(m.parameters(), lr=0.1),
+            named_parameters=m.named_parameters(),
+            gradient_predivide_factor=factor)
+        x = torch.randn(4, 3)
+        m(x).square().mean().backward()
+        opt.step()
+        return [p.detach().clone() for p in m.parameters()]
+
+    for p1, p2 in zip(fit(1.0), fit(2.0)):
+        torch.testing.assert_close(p1, p2, rtol=1e-5, atol=1e-6)
+
+    with pytest.raises(ValueError, match="gradient_predivide_factor"):
+        m = torch.nn.Linear(2, 1)
+        hvd_t.DistributedOptimizer(
+            torch.optim.SGD(m.parameters(), lr=0.1),
+            named_parameters=m.named_parameters(),
+            op=hvd_t.Sum, gradient_predivide_factor=2.0)
