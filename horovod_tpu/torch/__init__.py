@@ -271,10 +271,8 @@ class _DistributedOptimizer:
         # factor is accepted for API parity and is numerically neutral —
         # the overflow problem it works around does not exist on this
         # data plane.
-        self._prescale = 1.0 / gradient_predivide_factor \
-            if gradient_predivide_factor != 1.0 else 1.0
-        self._postscale = gradient_predivide_factor \
-            if gradient_predivide_factor != 1.0 else 1.0
+        self._prescale = 1.0 / gradient_predivide_factor
+        self._postscale = gradient_predivide_factor
         self._fusion_threshold = fusion_threshold_bytes
         self._pass_count: Dict[int, int] = {}
         self._ctxs: Dict[Any, Any] = {}
