@@ -37,6 +37,7 @@ def _run_example(script, *args, timeout=420, devices=8):
      ("--model", "resnet18", "--batch-size", "4", "--num-warmup-batches",
       "1", "--num-batches-per-iter", "1", "--num-iters", "1")),
     ("jax_moe_train.py", ("--steps", "6")),
+    ("jax_pipeline_train.py", ("--steps", "10")),
     ("jax_ulysses_long_context.py", ("--seq-len", "256", "--iters", "1")),
     ("jax_checkpoint_resume.py", ()),
     ("spark_estimator_train.py", ("--epochs", "2")),
