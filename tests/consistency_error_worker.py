@@ -1,0 +1,67 @@
+"""Worker for the cross-process metadata-mismatch error test.
+
+Reference behavior being mirrored: test_torch.py:325-434 — ranks submit
+mismatched shapes/dtypes for the same tensor name and EVERY rank must
+raise (the reference's coordinator returns an error Response to all);
+a deadlock or a single-rank failure is a bug. Here the default-on
+consistency exchange (collectives._check_consistency) must surface
+TensorValidationError on both ranks.
+
+Modes (CONSISTENCY_TEST_MODE):
+  shape  — same name, different shapes per rank
+  dtype  — same name, different dtypes per rank
+  ok     — matched metadata; must NOT raise (guards false positives)
+"""
+
+import os
+import sys
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ.pop("XLA_FLAGS", None)
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+import horovod_tpu as hvd  # noqa: E402
+from horovod_tpu.exceptions import TensorValidationError  # noqa: E402
+
+MODE = os.environ.get("CONSISTENCY_TEST_MODE", "shape")
+
+
+def main():
+    hvd.init()
+    rank = hvd.rank()
+
+    # a matched collective first: the plane itself works
+    out = hvd.allreduce(np.ones(3, np.float32), op=hvd.Sum, name="warm")
+    np.testing.assert_allclose(np.asarray(out), hvd.size() * np.ones(3))
+
+    if MODE == "shape":
+        x = np.ones(4 if rank == 0 else 5, np.float32)
+    elif MODE == "dtype":
+        x = np.ones(4, np.float32 if rank == 0 else np.float64)
+    else:
+        x = np.ones(4, np.float32)
+
+    try:
+        hvd.allreduce(x, op=hvd.Sum, name="mismatched")
+    except TensorValidationError as e:
+        if MODE == "ok":
+            print(f"rank {rank}: unexpected validation error: {e}",
+                  flush=True)
+            return 1
+        print(f"rank {rank}: CAUGHT TensorValidationError", flush=True)
+        return 0
+    if MODE == "ok":
+        print(f"rank {rank}: OK", flush=True)
+        hvd.shutdown()
+        return 0
+    print(f"rank {rank}: mismatched submission did NOT raise", flush=True)
+    return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -23,14 +23,15 @@ def _free_port():
         return s.getsockname()[1]
 
 
-def _launch(n, extra_env=None, timeout=180):
+def _launch(n, extra_env=None, timeout=180, script=None):
+    script = script or WORKER
     port = _free_port()
     procs = []
     for pid in range(n):
         env = dict(os.environ)
         env.pop("XLA_FLAGS", None)
         env.pop("PALLAS_AXON_POOL_IPS", None)
-        repo_root = os.path.dirname(os.path.dirname(os.path.abspath(WORKER)))
+        repo_root = os.path.dirname(os.path.dirname(os.path.abspath(script)))
         env.update({
             "PYTHONPATH": repo_root + os.pathsep + env.get("PYTHONPATH", ""),
             "JAX_PLATFORMS": "cpu",
@@ -40,7 +41,7 @@ def _launch(n, extra_env=None, timeout=180):
         })
         env.update(extra_env or {})
         procs.append(subprocess.Popen(
-            [sys.executable, WORKER], env=env,
+            [sys.executable, script], env=env,
             stdout=subprocess.PIPE, stderr=subprocess.STDOUT))
     outs = []
     codes = []
@@ -75,31 +76,39 @@ def test_multiprocess_join_uneven_data(n):
     operations.cc:942-966). Rank r trains 2+r batches; early finishers
     contribute zeros via the round-replay protocol and join() reports the
     longest-running rank."""
-    port = _free_port()
-    procs = []
-    for pid in range(n):
-        env = dict(os.environ)
-        env.pop("XLA_FLAGS", None)
-        env.pop("PALLAS_AXON_POOL_IPS", None)
-        repo_root = os.path.dirname(
-            os.path.dirname(os.path.abspath(JOIN_WORKER)))
-        env.update({
-            "PYTHONPATH": repo_root + os.pathsep + env.get("PYTHONPATH", ""),
-            "JAX_PLATFORMS": "cpu",
-            "HVD_TPU_COORDINATOR_ADDR": f"127.0.0.1:{port}",
-            "HVD_TPU_SIZE": str(n),
-            "HVD_TPU_RANK": str(pid),
-        })
-        procs.append(subprocess.Popen(
-            [sys.executable, JOIN_WORKER], env=env,
-            stdout=subprocess.PIPE, stderr=subprocess.STDOUT))
-    for i, p in enumerate(procs):
-        try:
-            out, _ = p.communicate(timeout=180)
-        except subprocess.TimeoutExpired:
-            for q in procs:
-                q.kill()
-            raise
-        o = out.decode(errors="replace")
-        assert p.returncode == 0, f"worker {i} failed:\n{o[-4000:]}"
+    codes, outs = _launch(n, script=JOIN_WORKER)
+    for i, (c, o) in enumerate(zip(codes, outs)):
+        assert c == 0, f"worker {i} failed:\n{o[-4000:]}"
         assert f"join worker {i} OK" in o
+
+
+# ---------------------------------------------------------------------------
+# round 3: cross-process metadata-mismatch error paths (reference:
+# test_torch.py:325-434 — mismatched shapes/dtypes must raise on EVERY
+# rank, never deadlock)
+# ---------------------------------------------------------------------------
+CONSISTENCY_WORKER = os.path.join(os.path.dirname(__file__),
+                                  "consistency_error_worker.py")
+
+
+@pytest.mark.integration
+@pytest.mark.parametrize("mode", ["shape", "dtype"])
+def test_mismatched_metadata_raises_on_every_rank(mode):
+    codes, outs = _launch(
+        2, script=CONSISTENCY_WORKER,
+        extra_env={"CONSISTENCY_TEST_MODE": mode})
+    for r, (code, out) in enumerate(zip(codes, outs)):
+        assert code == 0, f"rank {r} (mode {mode}):\n{out[-2000:]}"
+        assert "CAUGHT TensorValidationError" in out, (mode, r, out[-500:])
+
+
+@pytest.mark.integration
+def test_matched_metadata_does_not_false_positive():
+    codes, outs = _launch(
+        2, script=CONSISTENCY_WORKER,
+        extra_env={"CONSISTENCY_TEST_MODE": "ok"})
+    for r, (code, out) in enumerate(zip(codes, outs)):
+        assert code == 0, f"rank {r}:\n{out[-2000:]}"
+        # the marker proves the matched-mode path actually ran (a lost
+        # env var would fall back to the mismatch mode and pass vacuously)
+        assert f"rank {r}: OK" in out, out[-500:]
