@@ -97,6 +97,40 @@ def main():
         np.testing.assert_allclose(
             out, np.full((4,), sum(r + i for r in range(size))))
 
+    # -- round-3 verbs: grouped/async variants ------------------------------
+    h = hvd.grouped_allreduce_async(
+        [np.full((3,), float(rank + 1), np.float32),
+         np.full((2, 2), float(rank), np.float64)],
+        op=hvd.Sum, name="grp_async")
+    outs = hvd.synchronize(h)
+    np.testing.assert_allclose(np.asarray(outs[0]),
+                               np.full((3,), size * (size + 1) / 2))
+    np.testing.assert_allclose(np.asarray(outs[1]),
+                               np.full((2, 2), sum(range(size))))
+
+    outs = hvd.grouped_broadcast(
+        [np.full((4,), float(rank), np.float32),
+         np.full((2,), float(rank * 10), np.float32)],
+        root_rank=1, name="grp_bc")
+    np.testing.assert_allclose(np.asarray(outs[0]), np.full((4,), 1.0))
+    np.testing.assert_allclose(np.asarray(outs[1]), np.full((2,), 10.0))
+
+    a2a_send = np.arange(size * 2, dtype=np.float32) + 100 * rank
+    a2a_expected = np.concatenate(
+        [np.arange(rank * 2, rank * 2 + 2, dtype=np.float32) + 100 * r
+         for r in range(size)])
+    h = hvd.alltoall_async(a2a_send, name="a2a_async")
+    out = np.asarray(hvd.synchronize(h))
+    np.testing.assert_allclose(out, a2a_expected)
+
+    # uneven splits: rank r sends r+1 rows to each destination
+    usend = np.full((size * (rank + 1), 2), float(rank), np.float32)
+    out = np.asarray(hvd.alltoall(
+        usend, splits=[rank + 1] * size, name="a2a_uneven"))
+    expect_rows = np.concatenate(
+        [np.full((r + 1, 2), float(r), np.float32) for r in range(size)])
+    np.testing.assert_allclose(out, expect_rows)
+
     # -- barrier -------------------------------------------------------------
     hvd.barrier()
 
