@@ -26,8 +26,17 @@ class Knob:
     name: str                       # HVD_TPU_<NAME>
     default: Any
     parser: Callable[[str], Any]
-    alias: Optional[str] = None     # HOROVOD_* compatibility alias
+    #: compatibility aliases, tried in order: a HOROVOD_* name and/or the
+    #: MPI/PMIx/SLURM per-task variables (reference: gloo_context.cc reads
+    #: HOROVOD_*; MPI env detection lets bare `mpirun/srun python train.py`
+    #: resolve rank identity without the launcher)
+    alias: "Optional[str | tuple]" = None
     help: str = ""
+
+    def aliases(self):
+        if self.alias is None:
+            return ()
+        return (self.alias,) if isinstance(self.alias, str) else tuple(self.alias)
 
 
 _REGISTRY: Dict[str, Knob] = {}
@@ -95,6 +104,55 @@ RANK = _register("RANK", -1, int, alias="HOROVOD_RANK")
 SIZE = _register("SIZE", -1, int, alias="HOROVOD_SIZE")
 LOCAL_RANK = _register("LOCAL_RANK", -1, int, alias="HOROVOD_LOCAL_RANK")
 LOCAL_SIZE = _register("LOCAL_SIZE", -1, int, alias="HOROVOD_LOCAL_SIZE")
+
+#: External-scheduler task-identity families (reference: MPI env detection
+#: that lets bare `mpirun/srun python train.py` work, docs/mpirun.rst).
+#: Each row is (rank, size, local_rank, local_size) env names. A family is
+#: adopted only when BOTH its rank AND size variables resolve — partial
+#: hits are ignored rather than guessed, because they are actively
+#: misleading: PMIX_RANK appears without any size variable on some PMIx
+#: launchers, and sbatch exports SLURM_PROCID=0 to the batch step itself
+#: (the per-step SLURM_STEP_NUM_TASKS guards that case: a plain batch
+#: step yields size 1 = single-process, exactly the pre-detection
+#: behavior). Local entries are best-effort within the adopted family.
+_MPI_FAMILIES = (
+    ("OMPI_COMM_WORLD_RANK", "OMPI_COMM_WORLD_SIZE",
+     "OMPI_COMM_WORLD_LOCAL_RANK", "OMPI_COMM_WORLD_LOCAL_SIZE"),
+    ("PMIX_RANK", "JSM_NAMESPACE_SIZE",
+     "JSM_NAMESPACE_LOCAL_RANK", "JSM_NAMESPACE_LOCAL_SIZE"),
+    ("SLURM_PROCID", "SLURM_STEP_NUM_TASKS",
+     "SLURM_LOCALID", "SLURM_STEP_TASKS_PER_NODE"),
+)
+
+
+def mpi_task_identity(environ=None) -> Dict[str, int]:
+    """{"RANK": r, "SIZE": n, ...} from the first coherent scheduler
+    family, or {} when none applies. Shared by Config.get's fallback and
+    the jsrun shim (runner/lsf.py) so the mapping lives in one place."""
+    env = os.environ if environ is None else environ
+
+    def parse(v):
+        # SLURM_STEP_TASKS_PER_NODE can be "4(x2)"; take the leading int
+        return int(str(v).split("(", 1)[0])
+
+    for rank_var, size_var, lrank_var, lsize_var in _MPI_FAMILIES:
+        r, s = env.get(rank_var), env.get(size_var)
+        if r is None or s is None:
+            continue
+        try:
+            out = {"RANK": parse(r), "SIZE": parse(s)}
+        except ValueError:
+            continue
+        for key, var in (("LOCAL_RANK", lrank_var),
+                         ("LOCAL_SIZE", lsize_var)):
+            v = env.get(var)
+            if v is not None:
+                try:
+                    out[key] = parse(v)
+                except ValueError:
+                    pass
+        return out
+    return {}
 CROSS_RANK = _register("CROSS_RANK", -1, int, alias="HOROVOD_CROSS_RANK")
 CROSS_SIZE = _register("CROSS_SIZE", -1, int, alias="HOROVOD_CROSS_SIZE")
 HOSTNAME = _register("HOSTNAME", "", str, alias="HOROVOD_HOSTNAME")
@@ -163,9 +221,16 @@ class Config:
         if name in self._overrides:
             return self._overrides[name]
         raw = os.environ.get("HVD_TPU_" + knob.name)
-        if raw is None and knob.alias:
-            raw = os.environ.get(knob.alias)
+        for alias in knob.aliases():
+            if raw is not None:
+                break
+            raw = os.environ.get(alias)
         if raw is None:
+            # external-scheduler fallback for the task-identity knobs
+            if name in (RANK, SIZE, LOCAL_RANK, LOCAL_SIZE):
+                ident = mpi_task_identity()
+                if name in ident:
+                    return ident[name]
             return knob.default
         try:
             return knob.parser(raw)

@@ -119,25 +119,12 @@ def make_jsrun_command(command: List[str], env: Dict[str, str],
 def jsrun_rank_env(environ) -> Dict[str, str]:
     """Map jsrun/PMIx per-task rank variables onto the HVD_TPU_* env
     contract (the role the reference's MPI basics play when launched by
-    jsrun: rank discovery from the MPI environment, common/basics.py)."""
-    def first(*names):
-        for n in names:
-            v = environ.get(n)
-            if v is not None:
-                return v
-        return None
-
-    mapping = {
-        "HVD_TPU_RANK": first("PMIX_RANK", "OMPI_COMM_WORLD_RANK",
-                              "JSM_NAMESPACE_RANK"),
-        "HVD_TPU_SIZE": first("JSM_NAMESPACE_SIZE",
-                              "OMPI_COMM_WORLD_SIZE"),
-        "HVD_TPU_LOCAL_RANK": first("JSM_NAMESPACE_LOCAL_RANK",
-                                    "OMPI_COMM_WORLD_LOCAL_RANK"),
-        "HVD_TPU_LOCAL_SIZE": first("JSM_NAMESPACE_LOCAL_SIZE",
-                                    "OMPI_COMM_WORLD_LOCAL_SIZE"),
-    }
-    return {k: v for k, v in mapping.items() if v is not None}
+    jsrun: rank discovery from the MPI environment, common/basics.py).
+    The family table lives in config.mpi_task_identity — one mapping,
+    shared with the env-detection fallback, so they cannot drift."""
+    from ..config import mpi_task_identity
+    return {f"HVD_TPU_{k}": str(v)
+            for k, v in mpi_task_identity(environ).items()}
 
 
 def _shim_main(argv: Optional[List[str]] = None) -> int:

@@ -1,6 +1,8 @@
 """World lifecycle and query tests (reference: test/test_torch.py rank/size
 smoke tests + basics.py API surface)."""
 
+import os
+
 import pytest
 
 import horovod_tpu as hvd
@@ -88,3 +90,64 @@ def test_mxnet_bridge_surface_is_gated():
         except ImportError:
             with pytest.raises(ImportError, match="mxnet"):
                 call()
+
+
+def test_mpi_env_rank_detection():
+    """Bare `mpirun/srun python train.py` resolves rank identity from the
+    first COHERENT scheduler env family (reference: MPI env detection,
+    docs/mpirun.rst). Partial families must not create identity: PMIX_RANK
+    without a size var, or sbatch's batch-step SLURM_PROCID, previously
+    turned fail-safe runs into wrong worlds."""
+    from horovod_tpu import config as _config
+
+    FAMILY_VARS = [v for fam in _config._MPI_FAMILIES for v in fam] + [
+        "HVD_TPU_RANK", "HOROVOD_RANK", "HVD_TPU_SIZE", "HOROVOD_SIZE",
+        "HVD_TPU_LOCAL_RANK", "HOROVOD_LOCAL_RANK",
+        "HVD_TPU_LOCAL_SIZE", "HOROVOD_LOCAL_SIZE",
+        "JSM_NAMESPACE_RANK", "SLURM_NTASKS"]
+
+    def with_env(env):
+        # hermetic: resolve against a controlled environ (CI itself may
+        # run under SLURM/jsrun and export these vars)
+        return _config.mpi_task_identity(env)
+
+    # OMPI family: coherent rank+size
+    ident = with_env({"OMPI_COMM_WORLD_RANK": "3",
+                      "OMPI_COMM_WORLD_SIZE": "8",
+                      "OMPI_COMM_WORLD_LOCAL_RANK": "1",
+                      "OMPI_COMM_WORLD_LOCAL_SIZE": "4"})
+    assert ident == {"RANK": 3, "SIZE": 8, "LOCAL_RANK": 1,
+                     "LOCAL_SIZE": 4}
+
+    # PMIx rank WITHOUT a size variable: no identity (silent
+    # single-process degradation would mean wrong gradients)
+    assert with_env({"PMIX_RANK": "2"}) == {}
+    # ... but with JSM size it is coherent
+    ident = with_env({"PMIX_RANK": "2", "JSM_NAMESPACE_SIZE": "4"})
+    assert ident["RANK"] == 2 and ident["SIZE"] == 4
+
+    # sbatch batch step (PROCID=0, step size 1): harmless single-process
+    ident = with_env({"SLURM_PROCID": "0", "SLURM_STEP_NUM_TASKS": "1",
+                      "SLURM_NTASKS": "4"})
+    assert ident == {"RANK": 0, "SIZE": 1}
+    # srun step: per-step vars give the real world; "4(x2)" parses
+    ident = with_env({"SLURM_PROCID": "5", "SLURM_STEP_NUM_TASKS": "8",
+                      "SLURM_LOCALID": "1",
+                      "SLURM_STEP_TASKS_PER_NODE": "4(x2)"})
+    assert ident == {"RANK": 5, "SIZE": 8, "LOCAL_RANK": 1,
+                     "LOCAL_SIZE": 4}
+
+    # Config.get precedence: HVD_TPU_ > HOROVOD_ > family detection
+    import unittest.mock as mock
+    base = {"OMPI_COMM_WORLD_RANK": "3", "OMPI_COMM_WORLD_SIZE": "8"}
+    with mock.patch.dict("os.environ", base, clear=False):
+        for v in FAMILY_VARS:
+            if v not in base:
+                os.environ.pop(v, None)
+        cfg = _config.Config()
+        assert cfg.get(_config.RANK) == 3
+        assert cfg.get(_config.SIZE) == 8
+        with mock.patch.dict("os.environ", {"HOROVOD_RANK": "5"}):
+            assert cfg.get(_config.RANK) == 5
+            with mock.patch.dict("os.environ", {"HVD_TPU_RANK": "6"}):
+                assert cfg.get(_config.RANK) == 6
