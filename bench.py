@@ -181,8 +181,11 @@ def worker_main(cpu: bool, batch_override=None):
         ]
     elif batch_override:
         stages = [
+            # quick line first, then the scanned full measurement
+            dict(batch_per_chip=batch_override, num_warmup_batches=1,
+                 num_batches_per_iter=2, num_iters=1),
             dict(batch_per_chip=batch_override, num_warmup_batches=5,
-                 num_batches_per_iter=10, num_iters=10),
+                 num_batches_per_iter=10, num_iters=10, scanned=True),
         ]
     else:
         stages = [

@@ -28,7 +28,7 @@ The eager-plane fusion threshold keeps its own online tuner
 """
 
 import time
-from typing import Callable, Dict, Optional, Sequence, Tuple
+from typing import Callable, Dict, Sequence, Tuple
 
 import numpy as np
 

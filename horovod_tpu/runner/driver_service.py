@@ -15,12 +15,10 @@ management-only interface. The protocol rides the HMAC-authenticated
 service layer (network.py).
 """
 
-import socket
 import threading
-from typing import Dict, List, Optional, Sequence, Set, Tuple
+from typing import Dict, List, Optional, Set, Tuple
 
-from .network import (AckResponse, BasicClient, BasicService, PingRequest,
-                      PingResponse)
+from .network import AckResponse, BasicClient, BasicService
 
 Addresses = Dict[str, List[Tuple[str, int]]]
 
