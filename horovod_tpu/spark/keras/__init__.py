@@ -49,6 +49,7 @@ class KerasEstimator(HorovodEstimator):
         batch_size, epochs = int(self.batch_size), int(self.epochs)
         shuffle, seed = bool(self.shuffle), int(self.random_seed)
         verbose = int(self.verbose)
+        validation = float(self.validation) if self.validation else 0.0
 
         def train_fn(rank: int, size: int, train_path: str):
             import keras
@@ -75,8 +76,12 @@ class KerasEstimator(HorovodEstimator):
             if size > 1:
                 opt = hvd_tf.DistributedOptimizer(opt)
             model.compile(optimizer=opt, loss=loss, metrics=metrics)
+            # validation fraction held out of this worker's shard
+            # (reference: estimator `validation` param, spark/common/
+            # params.py — val_* metrics land in the history)
             history = model.fit(x, y, batch_size=batch_size, epochs=epochs,
-                                shuffle=shuffle, verbose=verbose)
+                                shuffle=shuffle, verbose=verbose,
+                                validation_split=validation)
             return {"weights": [np.array(w) for w in model.get_weights()],
                     "history": {k: [float(v) for v in vs]
                                 for k, vs in history.history.items()}}

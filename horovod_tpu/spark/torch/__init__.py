@@ -51,6 +51,7 @@ class TorchEstimator(HorovodEstimator):
         label_cols = list(self.label_cols)
         batch_size, epochs = int(self.batch_size), int(self.epochs)
         shuffle, seed = bool(self.shuffle), int(self.random_seed)
+        validation = float(self.validation) if self.validation else 0.0
 
         def train_fn(rank: int, size: int, train_path: str):
             import torch
@@ -77,9 +78,17 @@ class TorchEstimator(HorovodEstimator):
             if yt.ndim == 1:
                 yt = yt[:, None]
 
+            # validation fraction held out of this worker's shard
+            # (reference: estimator `validation` param)
+            n_val = int(len(xt) * validation)
+            if n_val:
+                xv, yv = xt[-n_val:], yt[-n_val:]
+                xt, yt = xt[:-n_val], yt[:-n_val]
+
             g = torch.Generator().manual_seed(seed)
             n = len(xt)
             history = []
+            val_history = []
             for _ in range(epochs):
                 order = (torch.randperm(n, generator=g) if shuffle
                          else torch.arange(n))
@@ -92,9 +101,19 @@ class TorchEstimator(HorovodEstimator):
                     opt.step()
                     epoch_loss += float(loss.detach()) * len(idx)
                 history.append(epoch_loss / max(n, 1))
+                if n_val:
+                    # eval mode: dropout off, batchnorm uses (and does
+                    # not update) running stats — the held-out set must
+                    # not leak into the shipped model
+                    model.eval()
+                    with torch.no_grad():
+                        val_history.append(
+                            float(loss_fn(model(xv), yv)))
+                    model.train()
             state = {k: v.cpu().numpy() if hasattr(v, "cpu") else v
                      for k, v in model.state_dict().items()}
-            return {"state_dict": state, "loss_history": history}
+            return {"state_dict": state, "loss_history": history,
+                    "val_loss_history": val_history}
 
         def _stack(arrays):
             out = [np.asarray(a) for a in arrays]
@@ -114,7 +133,9 @@ class TorchEstimator(HorovodEstimator):
         model.load_state_dict(state)
         return TorchModel(model, self.feature_cols, self.label_cols,
                           self.output_cols,
-                          loss_history=train_result.get("loss_history"))
+                          loss_history=train_result.get("loss_history"),
+                          val_loss_history=train_result.get(
+                              "val_loss_history"))
 
 
 class TorchModel(HorovodModel):
@@ -124,10 +145,11 @@ class TorchModel(HorovodModel):
     def __init__(self, model, feature_cols: List[str],
                  label_cols: List[str],
                  output_cols: Optional[List[str]] = None,
-                 loss_history=None):
+                 loss_history=None, val_loss_history=None):
         super().__init__(feature_cols, label_cols, output_cols)
         self.model = model
         self.loss_history = loss_history or []
+        self.val_loss_history = val_loss_history or []
 
     def getModel(self):
         return self.model

@@ -333,3 +333,41 @@ class TestTorchEstimator:
         # trained regressor must beat the zero predictor
         y = df["label"].to_numpy()
         assert np.mean((preds - y) ** 2) < np.mean(y ** 2)
+
+
+def test_torch_estimator_validation_split(hvd_world, tmp_path):
+    """The `validation` param holds out a fraction and records validation
+    loss — it must not be a silently-ignored knob. A Dropout layer guards
+    the eval-mode contract: val loss is computed with dropout off."""
+    torch = pytest.importorskip("torch")
+    from horovod_tpu.spark.torch import TorchEstimator
+    from horovod_tpu.spark.store import LocalStore
+
+    df = _regression_df()
+    net = torch.nn.Sequential(torch.nn.Linear(4, 8), torch.nn.ReLU(),
+                              torch.nn.Dropout(0.5), torch.nn.Linear(8, 1))
+    t_model = TorchEstimator(
+        model=net, optimizer=lambda p: torch.optim.Adam(p, lr=1e-2),
+        loss=torch.nn.MSELoss(),
+        feature_cols=[f"f{i}" for i in range(4)], label_cols=["label"],
+        batch_size=32, epochs=3, validation=0.25,
+        store=LocalStore(str(tmp_path))).fit(df)
+    assert len(t_model.val_loss_history) == 3
+    assert all(v > 0 for v in t_model.val_loss_history)
+
+
+def test_keras_estimator_validation_split(hvd_world, tmp_path):
+    keras = pytest.importorskip("keras")
+    from horovod_tpu.spark.keras import KerasEstimator
+    from horovod_tpu.spark.store import LocalStore
+
+    df = _regression_df()
+    k_model_builder = keras.Sequential([
+        keras.layers.Input(shape=(4,)), keras.layers.Dense(1)])
+    k_model = KerasEstimator(
+        model=k_model_builder, optimizer="adam", loss="mse",
+        feature_cols=[f"f{i}" for i in range(4)], label_cols=["label"],
+        batch_size=32, epochs=3, validation=0.25,
+        store=LocalStore(str(tmp_path))).fit(df)
+    assert "val_loss" in k_model.history
+    assert len(k_model.history["val_loss"]) == 3
