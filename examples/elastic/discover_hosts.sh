@@ -1,0 +1,10 @@
+#!/bin/sh
+# Sample host-discovery script for elastic launches (reference:
+# --host-discovery-script contract, runner/elastic/discovery.py — print
+# one "host" or "host:slots" per line; the driver polls this every
+# second and re-forms the world when the output changes).
+#
+# Replace with your resource manager's live-node query. This sample
+# reads a plain hosts file so you can edit membership mid-run:
+#   HOSTS_FILE=/tmp/hosts.txt ./discover_hosts.sh
+cat "${HOSTS_FILE:-/tmp/hvd_tpu_hosts.txt}" 2>/dev/null || echo "localhost:1"

@@ -52,6 +52,22 @@ class TorchEstimator(HorovodEstimator):
         batch_size, epochs = int(self.batch_size), int(self.epochs)
         shuffle, seed = bool(self.shuffle), int(self.random_seed)
         validation = float(self.validation) if self.validation else 0.0
+        if not 0.0 <= validation < 1.0:
+            raise ValueError(
+                f"validation must be a fraction in [0, 1), got "
+                f"{validation} (reference estimator `validation` param)")
+        # metrics: fn(outputs, targets) -> scalar, evaluated per epoch on
+        # the held-out set (reference: TorchEstimator metrics,
+        # spark/torch/estimator.py evaluation on the val DataLoader).
+        # Accepts {name: fn} or [fn, ...] (named by fn.__name__, the
+        # list convention the Keras sibling uses).
+        if isinstance(self.metrics, dict):
+            metric_fns = dict(self.metrics)
+        elif self.metrics:
+            metric_fns = {getattr(f, "__name__", f"metric_{i}"): f
+                          for i, f in enumerate(self.metrics)}
+        else:
+            metric_fns = {}
 
         def train_fn(rank: int, size: int, train_path: str):
             import torch
@@ -89,6 +105,7 @@ class TorchEstimator(HorovodEstimator):
             n = len(xt)
             history = []
             val_history = []
+            metrics_history = {name: [] for name in metric_fns}
             for _ in range(epochs):
                 order = (torch.randperm(n, generator=g) if shuffle
                          else torch.arange(n))
@@ -104,16 +121,23 @@ class TorchEstimator(HorovodEstimator):
                 if n_val:
                     # eval mode: dropout off, batchnorm uses (and does
                     # not update) running stats — the held-out set must
-                    # not leak into the shipped model
+                    # not leak into the shipped model. Restore the PRIOR
+                    # mode: a user may have frozen layers via .eval()
+                    # before handing the model over.
+                    was_training = model.training
                     model.eval()
                     with torch.no_grad():
-                        val_history.append(
-                            float(loss_fn(model(xv), yv)))
-                    model.train()
+                        out_v = model(xv)
+                        val_history.append(float(loss_fn(out_v, yv)))
+                        for name, fn in metric_fns.items():
+                            metrics_history[name].append(
+                                float(fn(out_v, yv)))
+                    model.train(was_training)
             state = {k: v.cpu().numpy() if hasattr(v, "cpu") else v
                      for k, v in model.state_dict().items()}
             return {"state_dict": state, "loss_history": history,
-                    "val_loss_history": val_history}
+                    "val_loss_history": val_history,
+                    "metrics_history": metrics_history}
 
         def _stack(arrays):
             out = [np.asarray(a) for a in arrays]
@@ -135,7 +159,9 @@ class TorchEstimator(HorovodEstimator):
                           self.output_cols,
                           loss_history=train_result.get("loss_history"),
                           val_loss_history=train_result.get(
-                              "val_loss_history"))
+                              "val_loss_history"),
+                          metrics_history=train_result.get(
+                              "metrics_history"))
 
 
 class TorchModel(HorovodModel):
@@ -145,11 +171,13 @@ class TorchModel(HorovodModel):
     def __init__(self, model, feature_cols: List[str],
                  label_cols: List[str],
                  output_cols: Optional[List[str]] = None,
-                 loss_history=None, val_loss_history=None):
+                 loss_history=None, val_loss_history=None,
+                 metrics_history=None):
         super().__init__(feature_cols, label_cols, output_cols)
         self.model = model
         self.loss_history = loss_history or []
         self.val_loss_history = val_loss_history or []
+        self.metrics_history = metrics_history or {}
 
     def getModel(self):
         return self.model

@@ -346,14 +346,19 @@ def test_torch_estimator_validation_split(hvd_world, tmp_path):
     df = _regression_df()
     net = torch.nn.Sequential(torch.nn.Linear(4, 8), torch.nn.ReLU(),
                               torch.nn.Dropout(0.5), torch.nn.Linear(8, 1))
+    def mae(outputs, targets):
+        return (outputs - targets).abs().mean()
+
     t_model = TorchEstimator(
         model=net, optimizer=lambda p: torch.optim.Adam(p, lr=1e-2),
-        loss=torch.nn.MSELoss(),
+        loss=torch.nn.MSELoss(), metrics={"mae": mae},
         feature_cols=[f"f{i}" for i in range(4)], label_cols=["label"],
         batch_size=32, epochs=3, validation=0.25,
         store=LocalStore(str(tmp_path))).fit(df)
     assert len(t_model.val_loss_history) == 3
     assert all(v > 0 for v in t_model.val_loss_history)
+    assert len(t_model.metrics_history["mae"]) == 3
+    assert all(v > 0 for v in t_model.metrics_history["mae"])
 
 
 def test_keras_estimator_validation_split(hvd_world, tmp_path):
@@ -371,3 +376,32 @@ def test_keras_estimator_validation_split(hvd_world, tmp_path):
         store=LocalStore(str(tmp_path))).fit(df)
     assert "val_loss" in k_model.history
     assert len(k_model.history["val_loss"]) == 3
+
+
+def test_torch_estimator_metrics_list_and_bad_validation(hvd_world,
+                                                         tmp_path):
+    torch = pytest.importorskip("torch")
+    from horovod_tpu.spark.torch import TorchEstimator
+    from horovod_tpu.spark.store import LocalStore
+
+    df = _regression_df(n=64)
+    net = torch.nn.Linear(4, 1)
+
+    def mae(outputs, targets):
+        return (outputs - targets).abs().mean()
+
+    # list-of-callables metrics (the Keras convention) must work too
+    m = TorchEstimator(
+        model=net, loss=torch.nn.MSELoss(), metrics=[mae],
+        feature_cols=[f"f{i}" for i in range(4)], label_cols=["label"],
+        batch_size=16, epochs=2, validation=0.25,
+        store=LocalStore(str(tmp_path))).fit(df)
+    assert len(m.metrics_history["mae"]) == 2
+
+    # out-of-range validation fails fast, not by silently inverting the
+    # train/val split
+    with pytest.raises(ValueError, match="validation"):
+        TorchEstimator(
+            model=net, loss=torch.nn.MSELoss(),
+            feature_cols=[f"f{i}" for i in range(4)],
+            label_cols=["label"], validation=-0.25).fit(df)
