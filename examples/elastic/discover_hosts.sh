@@ -7,4 +7,7 @@
 # Replace with your resource manager's live-node query. This sample
 # reads a plain hosts file so you can edit membership mid-run:
 #   HOSTS_FILE=/tmp/hosts.txt ./discover_hosts.sh
-cat "${HOSTS_FILE:-/tmp/hvd_tpu_hosts.txt}" 2>/dev/null || echo "localhost:1"
+# The -s guard keeps a momentarily-truncated file (editor save races)
+# from reporting an empty host set and tearing the world down.
+f="${HOSTS_FILE:-/tmp/hvd_tpu_hosts.txt}"
+if [ -s "$f" ]; then cat "$f"; else echo "localhost:1"; fi

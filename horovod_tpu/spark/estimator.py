@@ -158,6 +158,14 @@ class HorovodEstimator(EstimatorParams):
     def fit(self, df):
         """Materialize ``df`` and train; returns the fitted Model
         transformer (reference: estimator.py fit / _fit_on_prepared_data)."""
+        # validate shared params BEFORE the (possibly expensive) Parquet
+        # materialization, identically for every framework subclass
+        if self.validation is not None \
+                and not 0.0 <= float(self.validation) < 1.0:
+            raise ValueError(
+                f"validation must be a fraction in [0, 1), got "
+                f"{self.validation} (reference estimator `validation` "
+                f"param)")
         train_path = self._materialize(df)
         train_fn = self._make_train_fn()
         result = self._run_distributed(train_fn, train_path)

@@ -52,10 +52,6 @@ class TorchEstimator(HorovodEstimator):
         batch_size, epochs = int(self.batch_size), int(self.epochs)
         shuffle, seed = bool(self.shuffle), int(self.random_seed)
         validation = float(self.validation) if self.validation else 0.0
-        if not 0.0 <= validation < 1.0:
-            raise ValueError(
-                f"validation must be a fraction in [0, 1), got "
-                f"{validation} (reference estimator `validation` param)")
         # metrics: fn(outputs, targets) -> scalar, evaluated per epoch on
         # the held-out set (reference: TorchEstimator metrics,
         # spark/torch/estimator.py evaluation on the val DataLoader).
@@ -64,8 +60,14 @@ class TorchEstimator(HorovodEstimator):
         if isinstance(self.metrics, dict):
             metric_fns = dict(self.metrics)
         elif self.metrics:
-            metric_fns = {getattr(f, "__name__", f"metric_{i}"): f
-                          for i, f in enumerate(self.metrics)}
+            metric_fns = {}
+            for i, f in enumerate(self.metrics):
+                name = getattr(f, "__name__", None) or f"metric_{i}"
+                if name in metric_fns or name == "<lambda>":
+                    # disambiguate duplicates/lambdas instead of silently
+                    # keeping only the last same-named metric
+                    name = f"{name.strip('<>')}_{i}"
+                metric_fns[name] = f
         else:
             metric_fns = {}
 
@@ -121,10 +123,12 @@ class TorchEstimator(HorovodEstimator):
                 if n_val:
                     # eval mode: dropout off, batchnorm uses (and does
                     # not update) running stats — the held-out set must
-                    # not leak into the shipped model. Restore the PRIOR
-                    # mode: a user may have frozen layers via .eval()
-                    # before handing the model over.
-                    was_training = model.training
+                    # not leak into the shipped model. Snapshot the PRIOR
+                    # mode PER SUBMODULE: a user may have frozen
+                    # individual layers via .eval() before handing the
+                    # model over, and root-level train() would unfreeze
+                    # them.
+                    modes = [(m, m.training) for m in model.modules()]
                     model.eval()
                     with torch.no_grad():
                         out_v = model(xv)
@@ -132,7 +136,8 @@ class TorchEstimator(HorovodEstimator):
                         for name, fn in metric_fns.items():
                             metrics_history[name].append(
                                 float(fn(out_v, yv)))
-                    model.train(was_training)
+                    for m, was_training in modes:
+                        m.training = was_training
             state = {k: v.cpu().numpy() if hasattr(v, "cpu") else v
                      for k, v in model.state_dict().items()}
             return {"state_dict": state, "loss_history": history,
