@@ -31,10 +31,13 @@ kill window, whatever that window is):
      and re-prints the best line at exit.
   4. SIGTERM/SIGINT on the parent kills the worker and still prints the
      best-so-far line before exiting.
-  5. The accelerator backend is first probed in its own subprocess with a
-     hard timeout (this environment's PJRT plugin can hang in
-     make_c_api_client); if no accelerator comes up, a reduced CPU ladder
-     runs in a fresh subprocess, labeled "backend": "cpu_fallback".
+  5. The accelerator backend is probed in its own subprocess with a hard
+     per-attempt timeout (this environment's PJRT plugin can hang in
+     make_c_api_client), retrying with exponential backoff for as long as
+     the budget allows minus a CPU-fallback reserve (HVD_TPU_BENCH_CPU_
+     RESERVE, default 90 s). Only when the reserve is reached does a
+     reduced CPU ladder run, labeled "backend": "cpu_fallback" — a TPU
+     number at any batch size beats the best CPU number.
 """
 
 import json
@@ -50,8 +53,13 @@ REFERENCE_IMG_PER_SEC_PER_CHIP = 1656.82 / 16  # docs/benchmarks.rst:27-43
 _T0 = time.time()
 BUDGET_S = float(os.environ.get("HVD_TPU_BENCH_BUDGET", "420"))
 DEADLINE = _T0 + BUDGET_S
-PROBE_TIMEOUT_S = int(os.environ.get("HVD_TPU_BENCH_PROBE_TIMEOUT", "120"))
-PROBE_ATTEMPTS = int(os.environ.get("HVD_TPU_BENCH_PROBE_ATTEMPTS", "2"))
+PROBE_TIMEOUT_S = int(os.environ.get("HVD_TPU_BENCH_PROBE_TIMEOUT", "60"))
+# Keep probing the accelerator until only this much budget remains — the
+# CPU fallback needs ~80 s (compile + reduced ladder) plus margin. A TPU
+# number at ANY batch size beats the best CPU number by ~2 orders of
+# magnitude, so the right strategy on a flaky relay is persistence, not an
+# early surrender after two attempts.
+CPU_RESERVE_S = float(os.environ.get("HVD_TPU_BENCH_CPU_RESERVE", "90"))
 # Stop escalating to a new stage when less than this remains: a fresh
 # batch-size compile plus its measurement would not fit.
 STAGE_MARGIN_S = float(os.environ.get("HVD_TPU_BENCH_STAGE_MARGIN", "100"))
@@ -100,39 +108,54 @@ def _emit_best_and_exit(signum=None, frame=None):
 def probe_backend():
     """Check in a killable subprocess that the default jax backend comes up.
 
+    A healthy backend answers in seconds (r02 measured 9.4 s including
+    interpreter startup); a broken relay hangs in PJRT client init forever.
+    So: short per-attempt timeouts, exponential-backoff sleeps between
+    attempts, and KEEP TRYING until only ``CPU_RESERVE_S`` of the budget
+    remains — only then concede the accelerator and fall back.
+
     Returns (info dict or None, last error string).
     """
     last_err = ""
-    for attempt in range(1, PROBE_ATTEMPTS + 1):
-        # First attempt gets the full window; retries get a short one — a
-        # healthy backend answers in seconds, and two full-length hanging
-        # probes would eat the budget the CPU fallback needs.
-        cap = PROBE_TIMEOUT_S if attempt == 1 else min(PROBE_TIMEOUT_S, 45)
-        timeout = min(cap, max(10, _remaining() - 60))
+    attempt = 0
+    backoff = 5
+    while True:
+        remaining = _remaining()
+        if remaining <= CPU_RESERVE_S + 10:
+            _log(f"probe: {remaining:.0f}s left <= CPU reserve "
+                 f"{CPU_RESERVE_S:.0f}s; giving up on accelerator after "
+                 f"{attempt} attempts")
+            return None, last_err
+        attempt += 1
+        cap = PROBE_TIMEOUT_S if attempt == 1 else 45
+        timeout = min(cap, max(10, remaining - CPU_RESERVE_S))
         t0 = time.time()
         try:
             p = subprocess.run(
                 [sys.executable, "-c", _PROBE_CODE],
                 capture_output=True, text=True, timeout=timeout)
         except subprocess.TimeoutExpired:
-            last_err = (f"probe attempt {attempt}/{PROBE_ATTEMPTS}: no "
-                        f"backend after {timeout:.0f}s (PJRT init hang)")
+            last_err = (f"probe attempt {attempt}: no backend after "
+                        f"{timeout:.0f}s (PJRT init hang)")
             _log(last_err)
-            continue
-        for line in (p.stdout or "").splitlines():
-            if line.startswith("PROBE_OK|"):
-                _, platform, kind, n = line.strip().split("|")
-                _log(f"backend up in {time.time() - t0:.1f}s: "
-                     f"{platform} / {kind} x{n}")
-                return ({"platform": platform, "device_kind": kind,
-                         "num_devices": int(n)}, last_err)
-        tail = (p.stderr or p.stdout or "").strip().splitlines()[-6:]
-        last_err = (f"probe attempt {attempt}/{PROBE_ATTEMPTS}: rc="
-                    f"{p.returncode}: " + " | ".join(t.strip() for t in tail))
-        _log(last_err)
-        if attempt < PROBE_ATTEMPTS and _remaining() > 90:
-            time.sleep(5)
-    return None, last_err
+            p = None
+        if p is not None:
+            for line in (p.stdout or "").splitlines():
+                if line.startswith("PROBE_OK|"):
+                    _, platform, kind, n = line.strip().split("|")
+                    _log(f"backend up in {time.time() - t0:.1f}s "
+                         f"(attempt {attempt}): {platform} / {kind} x{n}")
+                    return ({"platform": platform, "device_kind": kind,
+                             "num_devices": int(n)}, last_err)
+            tail = (p.stderr or p.stdout or "").strip().splitlines()[-6:]
+            last_err = (f"probe attempt {attempt}: rc={p.returncode}: "
+                        + " | ".join(t.strip() for t in tail))
+            _log(last_err)
+        # Back off before the next try, but never sleep past the point
+        # where another probe would no longer fit before the CPU reserve.
+        if _remaining() > CPU_RESERVE_S + backoff + 15:
+            time.sleep(backoff)
+            backoff = min(backoff * 2, 30)
 
 
 def _result_json(r, backend_label, note=""):
@@ -196,16 +219,16 @@ def worker_main(cpu: bool, batch_override=None):
             # Stage 1: same compiled step, a quick honest measurement.
             dict(batch_per_chip=32, num_warmup_batches=2,
                  num_batches_per_iter=5, num_iters=2),
-            # Stage 2: reference-length measurement with the SCANNED
-            # k-step program (one XLA call per timed iteration — no
-            # per-step host dispatch in the measurement).
-            dict(batch_per_chip=32, num_warmup_batches=5,
-                 num_batches_per_iter=10, num_iters=10, scanned=True),
-            # Stages 3-4: larger batches for throughput/MFU, re-printing
-            # improved lines. Each costs a fresh compile.
-            dict(batch_per_chip=64, num_warmup_batches=5,
-                 num_batches_per_iter=10, num_iters=10, scanned=True),
+            # Stages 2-3: large batches with the SCANNED k-step program
+            # (one XLA call per timed iteration — no per-step host
+            # dispatch in the measurement), re-printing improved lines.
+            # Each costs a fresh compile. r4 measurement on a live v5e:
+            # batch 32→1694, 64→1866, 128→2309 img/s (mfu 0.21/0.23/0.28)
+            # — the intermediate sizes are not worth their compiles, so
+            # the ladder jumps straight to the MFU-bearing batches.
             dict(batch_per_chip=128, num_warmup_batches=5,
+                 num_batches_per_iter=10, num_iters=10, scanned=True),
+            dict(batch_per_chip=256, num_warmup_batches=5,
                  num_batches_per_iter=10, num_iters=10, scanned=True),
         ]
 

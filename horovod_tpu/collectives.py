@@ -115,6 +115,21 @@ def _get_program(w, key, builder):
     return fn
 
 
+def _stage_input(t):
+    """Coerce a collective input for staging WITHOUT forcing device data
+    through the host: a fully-addressable jax array is used as-is
+    (``device_put`` in ``_global_from_local`` moves it device-to-device if
+    needed), everything else becomes numpy. ``np.asarray`` on a jax array
+    would read it back to the host only to ship it straight back — the
+    round-4 microbenchmark exists to catch exactly this class of staging
+    waste (reference analogue: the CudaOnCPU staging fallback vs the
+    direct-GPU path, torch/mpi_ops_v2.cc:92)."""
+    jax = _jax()
+    if isinstance(t, jax.Array) and t.is_fully_addressable:
+        return t
+    return np.asarray(t)
+
+
 def _global_from_local(wm, local_np, extra_leading=True):
     """Stack this process's value as its row of a (nproc, ...) global array."""
     jax = _jax()
@@ -360,7 +375,7 @@ def _allreduce_impl(w, values, op, prescale_factor, postscale_factor,
         # After join(), this process contributes zeros to every further
         # reduction (reference: GetTensorEntriesFromResponse substitutes zero
         # tensors for joined ranks, tensor_queue.cc).
-        values = [np.zeros_like(np.asarray(v)) for v in values]
+        values = [np.zeros(v.shape, v.dtype) for v in values]
 
     if op == ReduceOp.ADASUM:
         from .adasum import adasum_eager
@@ -395,22 +410,55 @@ def _allreduce_impl(w, values, op, prescale_factor, postscale_factor,
            tuple(scales), op.value)
 
     def build():
+        # Fusion buffer, in-program: same-dtype group members are packed
+        # into ONE flat buffer before the reduction so XLA emits one
+        # cross-process collective per dtype group instead of one per
+        # tensor (reference: fusion_buffer_manager.h:30-55,
+        # MemcpyInFusionBuffer/Out, collective_operations.cc:37-81). The
+        # round-4 microbenchmark measured the unfused grouped program at
+        # ~6x the latency of a single allreduce of the same payload at 2
+        # processes — per-collective launch latency dominates grouped
+        # members, exactly the cost the reference's fusion buffer
+        # amortizes (MICROBENCH.json, docs/tensor-fusion.md).
+        shapes = [tuple(v.shape) for v in values]
+        numels = [int(np.prod(s)) if s else 1 for s in shapes]
+        groups: dict = {}
+        for i, v in enumerate(values):
+            groups.setdefault(str(v.dtype), []).append(i)
+
+        def _reduce1(g):
+            acc = g
+            if g.dtype == jnp.bfloat16 or g.dtype == jnp.float16:
+                acc = g.astype(jnp.float32)  # accumulate halfs in fp32
+            return reducer(acc, axis=0)
+
         def f(*stacked):
-            out = []
-            for g, s in zip(stacked, scales):
-                dtype = g.dtype
-                acc = g
-                if dtype == jnp.bfloat16 or dtype == jnp.float16:
-                    acc = g.astype(jnp.float32)  # accumulate halfs in fp32
-                r = reducer(acc, axis=0)
-                if s != 1.0:
-                    r = r * s
-                out.append(r.astype(dtype))
+            out = [None] * len(stacked)
+            for idxs in groups.values():
+                if len(idxs) == 1:
+                    i = idxs[0]
+                    r = _reduce1(stacked[i])
+                    if scales[i] != 1.0:
+                        r = r * scales[i]
+                    out[i] = r.astype(stacked[i].dtype)
+                    continue
+                buf = jnp.concatenate(
+                    [stacked[i].reshape((nproc, numels[i])) for i in idxs],
+                    axis=1)
+                r = _reduce1(buf)
+                off = 0
+                for i in idxs:
+                    piece = r[off:off + numels[i]]
+                    off += numels[i]
+                    if scales[i] != 1.0:
+                        piece = piece * scales[i]
+                    out[i] = piece.reshape(shapes[i]).astype(
+                        stacked[i].dtype)
             return tuple(out)
         return jax.jit(f, out_shardings=wm.replicated_sharding())
     fn = _get_program(w, sig, build)
 
-    globals_ = [_global_from_local(wm, np.asarray(v)) for v in values]
+    globals_ = [_global_from_local(wm, v) for v in values]
     outs = fn(*globals_)
     if not isinstance(outs, tuple):
         outs = (outs,)
@@ -445,7 +493,7 @@ def allreduce_async(tensor, average=None, name: Optional[str] = None,
     tl = w.timeline
     tl.start(name, "allreduce")
     wm = process_set or w.world_mesh
-    local = np.asarray(tensor)
+    local = _stage_input(tensor)
     try:
         # Cheap argument validation stays on the caller thread so misuse
         # raises at the call site (reference: Enqueue* rejects bad args
@@ -467,7 +515,8 @@ def allreduce_async(tensor, average=None, name: Optional[str] = None,
         _check_consistency(w, wm, name, local.shape, local.dtype,
                            "allreduce", op.value)
         tl.activity_start(name, _tl.XLA_ALLREDUCE)
-        vals = [np.zeros_like(local)] if joined_at_submit else [local]
+        vals = [np.zeros(local.shape, local.dtype)] \
+            if joined_at_submit else [local]
         (out,) = _allreduce_impl(w, vals, op, prescale_factor,
                                  postscale_factor, process_set, internal=True)
         tl.activity_end(name)
@@ -520,7 +569,7 @@ def grouped_allreduce_async(tensors: Sequence, average=None,
     tl = w.timeline
     tl.start(base, "grouped_allreduce")
     wm = process_set or w.world_mesh
-    locals_ = [np.asarray(t) for t in tensors]
+    locals_ = [_stage_input(t) for t in tensors]
     try:
         for l in locals_:
             _combined_scale(op, wm.num_procs, prescale_factor,
@@ -542,8 +591,8 @@ def grouped_allreduce_async(tensors: Sequence, average=None,
                            "grouped_allreduce",
                            extra=f"{shapes}|{dtypes}|{op.value}")
         tl.activity_start(base, _tl.XLA_ALLREDUCE)
-        vals = [np.zeros_like(l) for l in locals_] if joined_at_submit \
-            else locals_
+        vals = [np.zeros(l.shape, l.dtype) for l in locals_] \
+            if joined_at_submit else locals_
         outs = _allreduce_impl(w, vals, op, prescale_factor,
                                postscale_factor, process_set, internal=True)
         tl.activity_end(base)

@@ -1,0 +1,162 @@
+"""Collective-plane microbenchmarks.
+
+The reference treats eager collective dispatch as its hot loop — fused
+buffers (fusion_buffer_manager.h:30-55), a 5 ms negotiation cycle, and a
+finalizer pool that pipelines back-to-back NCCL launches
+(gpu_operations.cc:60-87). Our eager plane replaces all of that with one
+jitted XLA reduction per dispatch, staging host values to the device on
+the way in. This module measures that design instead of assuming it:
+
+* :func:`eager_sweep` — payload sweep (1 KB → 256 MB) of the eager
+  ``allreduce`` / ``grouped_allreduce`` path, reporting bytes/sec, the
+  async dispatch latency (time for ``allreduce_async`` to return to the
+  caller), and the ratio against an **in-jit** reduction of the very same
+  global payload with pre-staged device inputs. The gap between the two
+  IS the eager plane's staging + host-dispatch overhead — the quantity
+  the reference's fusion buffer exists to amortize.
+* :func:`scaling_sweep_point` — compiled-data-plane train step (the same
+  DistributedOptimizer path ``bench.py`` measures) over every visible
+  device, reporting throughput for one device count. The driver script
+  (``microbench.py`` at the repo root) sweeps 1→8 virtual CPU devices and
+  computes scaling efficiency — exercising the measurement machinery a
+  real pod run needs (virtual CPU devices share host cores, so the CPU
+  efficiency trend is a machinery check, not a performance claim).
+
+Results are written to ``MICROBENCH.json`` by the root script and cited
+in ``docs/tensor-fusion.md``.
+"""
+
+import time
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+# Payload ladder: 1 KB → 256 MB (reference fusion threshold is 64 MB;
+# common.h:95). The top sizes are where bandwidth dominates, the bottom
+# where per-dispatch overhead dominates.
+DEFAULT_SIZES = (1 << 10, 1 << 14, 1 << 17, 1 << 20, 1 << 23, 1 << 26,
+                 1 << 28)
+
+
+def _timeit(fn, iters: int, warmup: int = 1) -> float:
+    """Median wall-clock seconds of ``fn()`` over ``iters`` runs."""
+    for _ in range(warmup):
+        fn()
+    ts = []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        fn()
+        ts.append(time.perf_counter() - t0)
+    return float(np.median(ts))
+
+
+def eager_sweep(sizes: Sequence[int] = DEFAULT_SIZES, iters: int = 5,
+                group: int = 8) -> List[dict]:
+    """Sweep eager collectives over payload sizes. Must run inside an
+    initialized world (any process count); every rank executes the same
+    sequence (SPMD lockstep), results are identical across ranks."""
+    import jax
+    import jax.numpy as jnp
+
+    import horovod_tpu as hvd
+    from . import collectives
+
+    w = collectives._world()
+    wm = w.world_mesh
+    nproc = wm.num_procs
+    results = []
+
+    for size in sizes:
+        n_el = max(1, size // 4)
+        x = np.ones((n_el,), np.float32)
+        payload = n_el * 4
+
+        # --- eager allreduce: full round trip, host in → host-visible out.
+        def run_allreduce():
+            out = hvd.allreduce(x, op=hvd.Sum, name=f"mb_ar_{size}")
+            np.asarray(out)  # force the result all the way back
+
+        t_eager = _timeit(run_allreduce, iters)
+
+        # --- async dispatch latency: how long the caller thread is blocked
+        # per submission (the reference's EnqueueTensorAllreduce cost).
+        handles = []
+
+        def run_dispatch():
+            t0 = time.perf_counter()
+            h = hvd.allreduce_async(x, op=hvd.Sum, name=f"mb_ard_{size}")
+            dt = time.perf_counter() - t0
+            handles.append((h, dt))
+
+        lat = []
+        for _ in range(iters):
+            run_dispatch()
+            h, dt = handles.pop()
+            lat.append(dt)
+            hvd.synchronize(h)
+        t_dispatch = float(np.median(lat))
+
+        # --- grouped allreduce: ``group`` tensors fused into one dispatch.
+        chunk = max(1, n_el // group)
+        xs = [np.ones((chunk,), np.float32) for _ in range(group)]
+
+        def run_grouped():
+            outs = hvd.grouped_allreduce(xs, op=hvd.Sum,
+                                         name=f"mb_gar_{size}")
+            np.asarray(outs[0])
+
+        t_grouped = _timeit(run_grouped, iters)
+
+        # --- in-jit reduction of the SAME global payload with inputs
+        # already staged on device: the compiled-plane cost floor. The
+        # program is identical to the eager plane's (sum over the proc
+        # axis); only staging and per-call host work differ.
+        stacked = collectives._global_from_local(wm, x)
+        if nproc > 1:
+            injit = jax.jit(lambda g: jnp.sum(g, axis=0),
+                            out_shardings=wm.replicated_sharding())
+        else:
+            injit = jax.jit(lambda g: jnp.sum(g, axis=0))
+
+        def run_injit():
+            injit(stacked).block_until_ready()
+
+        t_injit = _timeit(run_injit, iters)
+
+        results.append({
+            "payload_bytes": payload,
+            "nproc": nproc,
+            "eager_allreduce_s": t_eager,
+            "eager_bytes_per_s": payload / t_eager,
+            "dispatch_latency_s": t_dispatch,
+            "grouped_allreduce_s": t_grouped,
+            "grouped_bytes_per_s": (chunk * 4 * group) / t_grouped,
+            "injit_reduce_s": t_injit,
+            "eager_over_injit": t_eager / t_injit if t_injit > 0 else None,
+        })
+    return results
+
+
+def scaling_sweep_point(batch_per_device: int = 8, image_size: int = 32,
+                        model_name: str = "resnet18",
+                        num_iters: int = 3,
+                        num_batches_per_iter: int = 5) -> dict:
+    """One point of the compiled-plane scaling sweep: DP train step over
+    every visible device (the bench.py data plane), returning throughput.
+    The root script runs this under 1/2/4/8 virtual CPU devices and
+    derives efficiency = T(n) / (n * T(1))."""
+    import jax
+
+    from .benchmark import _Rig
+
+    rig = _Rig(batch_per_device, image_size, model_name, "sgd")
+    r = rig.run_stage(num_warmup_batches=2,
+                      num_batches_per_iter=num_batches_per_iter,
+                      num_iters=num_iters)
+    return {
+        "num_devices": r.num_chips,
+        "batch_per_device": r.batch_per_chip,
+        "images_per_sec_total": r.images_per_sec_total,
+        "images_per_sec_per_device": r.images_per_sec_per_chip,
+        "platform": r.platform,
+    }

@@ -1,0 +1,204 @@
+#!/usr/bin/env python
+"""Collective-plane microbenchmark driver (VERDICT r3 item 2).
+
+Runs three sections, each in killable CPU subprocesses, and writes
+``MICROBENCH.json``:
+
+1. ``eager_1proc``  — payload sweep of the eager plane with one process:
+   pure dispatch + staging overhead (no cross-process communication).
+2. ``eager_2proc``  — the same sweep across 2 processes rendezvousing
+   through the JAX distributed coordinator (the launcher's env contract):
+   bytes/sec of eager allreduce / grouped_allreduce, async dispatch
+   latency, and the ratio vs an in-jit reduction of the same pre-staged
+   payload.
+3. ``scaling``      — compiled-plane DP train step under 1/2/4/8 virtual
+   CPU devices (``--xla_force_host_platform_device_count``), reporting
+   throughput and efficiency = T(n)/(n*T(1)). Virtual CPU devices share
+   host cores, so this validates the measurement machinery rather than
+   claiming performance — the real-pod run reuses exactly this path.
+
+Usage: ``python microbench.py [--quick]``. Workers are internal
+(``--worker-eager`` / ``--worker-scaling``).
+"""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+import time
+
+MB_TAG = "MB_JSON "
+ROOT = os.path.dirname(os.path.abspath(__file__))
+
+
+def _log(msg):
+    sys.stderr.write(f"[microbench] {msg}\n")
+    sys.stderr.flush()
+
+
+def _free_port():
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _cpu_env(extra=None):
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    # The axon PJRT relay dials the device at interpreter startup; the CPU
+    # sections must not depend on accelerator reachability.
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = ROOT + os.pathsep + env.get("PYTHONPATH", "")
+    env.update(extra or {})
+    return env
+
+
+def _collect(out: str):
+    rows = []
+    for line in out.splitlines():
+        if line.startswith(MB_TAG):
+            rows.append(json.loads(line[len(MB_TAG):]))
+    return rows
+
+
+def _run_eager(nproc: int, quick: bool, timeout: int):
+    port = _free_port()
+    procs = []
+    for rank in range(nproc):
+        env = _cpu_env({
+            "HVD_TPU_COORDINATOR_ADDR": f"127.0.0.1:{port}",
+            "HVD_TPU_SIZE": str(nproc),
+            "HVD_TPU_RANK": str(rank),
+        } if nproc > 1 else {})
+        cmd = [sys.executable, os.path.abspath(__file__), "--worker-eager"]
+        if quick:
+            cmd.append("--quick")
+        procs.append(subprocess.Popen(cmd, env=env, text=True,
+                                      stdout=subprocess.PIPE,
+                                      stderr=sys.stderr))
+    outs = []
+    for p in procs:
+        try:
+            out, _ = p.communicate(timeout=timeout)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            raise
+        outs.append(out or "")
+    if any(p.returncode != 0 for p in procs):
+        _log(f"eager {nproc}-proc worker failed "
+             f"(rcs={[p.returncode for p in procs]})")
+        return None
+    return _collect(outs[0])
+
+
+def _run_scaling(n: int, quick: bool, timeout: int):
+    env = _cpu_env({
+        "XLA_FLAGS": f"--xla_force_host_platform_device_count={n}",
+    })
+    cmd = [sys.executable, os.path.abspath(__file__),
+           f"--worker-scaling={n}"]
+    if quick:
+        cmd.append("--quick")
+    try:
+        p = subprocess.run(cmd, env=env, text=True, capture_output=True,
+                           timeout=timeout)
+    except subprocess.TimeoutExpired:
+        _log(f"scaling n={n}: timeout")
+        return None
+    sys.stderr.write(p.stderr or "")
+    if p.returncode != 0:
+        _log(f"scaling n={n}: rc={p.returncode}")
+        return None
+    rows = _collect(p.stdout or "")
+    return rows[0] if rows else None
+
+
+# ---------------------------------------------------------------- workers
+
+def worker_eager(quick: bool) -> int:
+    import horovod_tpu as hvd
+    from horovod_tpu.microbench import DEFAULT_SIZES, eager_sweep
+
+    hvd.init()
+    sizes = DEFAULT_SIZES[:4] if quick else DEFAULT_SIZES
+    rows = eager_sweep(sizes=sizes, iters=3 if quick else 5)
+    if hvd.rank() == 0:
+        for r in rows:
+            print(MB_TAG + json.dumps(r))
+    hvd.shutdown()
+    return 0
+
+
+def worker_scaling(n: int, quick: bool) -> int:
+    from horovod_tpu.microbench import scaling_sweep_point
+    row = scaling_sweep_point(
+        batch_per_device=4 if quick else 8,
+        image_size=32,
+        num_iters=2 if quick else 3,
+        num_batches_per_iter=3 if quick else 5)
+    assert row["num_devices"] == n, (row, n)
+    print(MB_TAG + json.dumps(row))
+    return 0
+
+
+# ----------------------------------------------------------------- parent
+
+def main():
+    quick = "--quick" in sys.argv
+    for a in sys.argv[1:]:
+        if a == "--worker-eager":
+            return worker_eager(quick)
+        if a.startswith("--worker-scaling="):
+            return worker_scaling(int(a.split("=", 1)[1]), quick)
+
+    t0 = time.time()
+    result = {"quick": quick}
+
+    _log("section 1/3: eager sweep, 1 process")
+    result["eager_1proc"] = _run_eager(1, quick, timeout=600)
+
+    _log("section 2/3: eager sweep, 2 processes")
+    result["eager_2proc"] = _run_eager(2, quick, timeout=900)
+
+    _log("section 3/3: compiled-plane scaling sweep")
+    points = []
+    for n in (1, 2, 4, 8):
+        row = _run_scaling(n, quick, timeout=600)
+        if row:
+            points.append(row)
+            _log(f"  n={n}: {row['images_per_sec_total']:.1f} img/s total")
+    base = next((p for p in points if p["num_devices"] == 1), None)
+    for p in points:
+        if base:
+            p["efficiency_vs_1dev"] = round(
+                p["images_per_sec_total"]
+                / (p["num_devices"] * base["images_per_sec_total"]), 3)
+    result["scaling"] = points
+    result["wall_s"] = round(time.time() - t0, 1)
+
+    out_path = os.path.join(ROOT, "MICROBENCH.json")
+    with open(out_path, "w") as f:
+        json.dump(result, f, indent=1)
+    _log(f"wrote {out_path} in {result['wall_s']}s")
+
+    # one-line summary for the driver log
+    two = result.get("eager_2proc") or []
+    big = two[-1] if two else None
+    print(json.dumps({
+        "metric": "collective_microbench",
+        "eager_2proc_peak_bytes_per_s": round(big["eager_bytes_per_s"])
+        if big else None,
+        "eager_over_injit_at_peak": round(big["eager_over_injit"], 2)
+        if big else None,
+        "dispatch_latency_us": round(
+            min(r["dispatch_latency_s"] for r in two) * 1e6) if two else None,
+        "scaling_points": len(result["scaling"]),
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
