@@ -19,7 +19,8 @@ framework supplies the full set as first-class, mesh-native components:
   over the 'ep' axis (:mod:`.moe`).
 """
 
-from .mesh_utils import MeshConfig, make_training_mesh, TRANSFORMER_RULES  # noqa: F401
+from .mesh_utils import (MeshConfig, make_training_mesh,  # noqa: F401
+                         TRANSFORMER_RULES, fsdp_sharded_leaves)
 from .hierarchical import hierarchical_allreduce, hierarchical_pmean  # noqa: F401
 from .ring_attention import (  # noqa: F401
     ring_attention, ring_attention_flash,

@@ -76,6 +76,20 @@ def batch_spec():
     return P(("dp", "fsdp"))
 
 
+def fsdp_sharded_leaves(params):
+    """Leaves of ``params`` that are genuinely ZeRO-sharded over the 'fsdp'
+    mesh axis: their addressable shard is strictly smaller than the global
+    leaf AND their PartitionSpec names 'fsdp'. Used by tests and the driver
+    dryrun to PROVE fsdp>1 shards parameters rather than trusting the spec.
+    """
+    import jax
+    return [
+        p for p in jax.tree_util.tree_leaves(params)
+        if p.addressable_shards[0].data.size < p.size
+        and "fsdp" in str(p.sharding.spec)
+    ]
+
+
 def param_shardings(mesh, abstract_variables, rules=TRANSFORMER_RULES):
     """NamedShardings for a flax variables pytree annotated with
     with_logical_partitioning."""

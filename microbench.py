@@ -83,9 +83,12 @@ def _run_eager(nproc: int, quick: bool, timeout: int):
         try:
             out, _ = p.communicate(timeout=timeout)
         except subprocess.TimeoutExpired:
+            # degrade the section to null, like _run_scaling — the other
+            # sections must still run and MICROBENCH.json must be written
             for q in procs:
                 q.kill()
-            raise
+            _log(f"eager {nproc}-proc: timeout after {timeout}s")
+            return None
         outs.append(out or "")
     if any(p.returncode != 0 for p in procs):
         _log(f"eager {nproc}-proc worker failed "
