@@ -338,7 +338,8 @@ class ElasticDriver:
                 slot_info.hostname, slot_info.local_rank)
         else:
             rid = self._worker_registry.record_failure(
-                slot_info.hostname, slot_info.local_rank)
+                slot_info.hostname, slot_info.local_rank,
+                timestamp=timestamp)
         if self.finished() and self._worker_registry.last_rendezvous() == rid:
             name = f"{slot_info.hostname}[{slot_info.local_rank}]"
             self._results.add_result(name, (exit_code, timestamp))

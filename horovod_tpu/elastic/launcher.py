@@ -61,6 +61,7 @@ def _make_create_worker_fn(command, rendezvous, rendezvous_addr: str,
         watcher = threading.Thread(target=watch_events, daemon=True)
         watcher.start()
         out_file = None
+        exit_info: dict = {}
         try:
             if output_dir:
                 os.makedirs(output_dir, exist_ok=True)
@@ -71,12 +72,14 @@ def _make_create_worker_fn(command, rendezvous, rendezvous_addr: str,
             code = safe_exec(
                 cmd, env=env,
                 stdout_prefix=f"[{slot_info.rank}]<stdout> ",
-                stop_event=stop, stdout_file=out_file)
+                stop_event=stop, stdout_file=out_file, exit_info=exit_info)
         finally:
             stop.set()
             if out_file:
                 out_file.close()
-        return code, time.time()
+        # exit_time is captured at wait() — before the stdout drain — so
+        # cascade-root ordering reflects actual death order.
+        return code, exit_info.get("exit_time", time.time())
 
     return create_worker
 
@@ -154,6 +157,14 @@ def launch_elastic(args) -> int:
         driver.start(args.np or min_np, create_worker_fn)
         results = driver.get_results()
         driver.stop()
+    except TimeoutError as e:
+        # wait_for_available_slots gave up: not enough discoverable slots
+        # (reference scenario: min-np timeout). Surface the reason cleanly
+        # instead of a traceback.
+        driver.stop()
+        import sys
+        sys.stderr.write(f"horovodrun-tpu: {e}\n")
+        return 1
     finally:
         if own_state_dir:
             shutil.rmtree(own_state_dir, ignore_errors=True)

@@ -34,7 +34,7 @@ class WorkerStateRegistry:
         self._lock = threading.Lock()
         self._states: dict = {}
         self._by_state: dict = {READY: set(), SUCCESS: set(), FAILURE: set()}
-        self._failure_order: list = []   # (host, slot) in arrival order
+        self._failure_order: list = []   # ((host, slot), exit_ts, arrival_i)
         self._barrier: Optional[threading.Barrier] = None
         self._rendezvous_id = 0
         self._size = 0
@@ -73,10 +73,12 @@ class WorkerStateRegistry:
     def record_success(self, host: str, slot: int) -> int:
         return self._record(host, slot, SUCCESS)
 
-    def record_failure(self, host: str, slot: int) -> int:
-        return self._record(host, slot, FAILURE)
+    def record_failure(self, host: str, slot: int,
+                       timestamp: Optional[float] = None) -> int:
+        return self._record(host, slot, FAILURE, timestamp=timestamp)
 
-    def _record(self, host: str, slot: int, state: str) -> int:
+    def _record(self, host: str, slot: int, state: str,
+                timestamp: Optional[float] = None) -> int:
         if self._driver.finished():
             return self._rendezvous_id
         if self._host_manager.is_blacklisted(host):
@@ -101,8 +103,18 @@ class WorkerStateRegistry:
                     return self._rendezvous_id
             self._states[key] = state
             self.get(state).add(key)
-            if state == FAILURE and key not in self._failure_order:
-                self._failure_order.append(key)
+            if state == FAILURE:
+                # (A duplicate FAILURE for this key early-returned above,
+                # so each key appears at most once.) Record the worker-
+                # reported exit timestamp alongside the arrival index:
+                # record ARRIVAL order is not causal order (a slow
+                # notification path can invert it), but exit timestamps
+                # are captured at wait() by the per-worker runner threads
+                # on the launcher host, so they share a clock and order
+                # causally — the cascade-root heuristic sorts on them.
+                self._failure_order.append(
+                    (key, timestamp if timestamp is not None
+                     else float("inf"), len(self._failure_order)))
             rid = self._rendezvous_id
 
         return self._wait(key, state, rid)
@@ -141,14 +153,21 @@ class WorkerStateRegistry:
             # Blacklist only the root host and respawn the remainder; a
             # genuinely-broken job converges anyway (one blacklist per
             # generation until min_np is unreachable or reset_limit hits).
-            root = self._failure_order[0] if self._failure_order else None
+            # Root = earliest worker-reported exit timestamp (arrival
+            # index breaks ties and covers records without a timestamp).
+            ordered = sorted(self._failure_order,
+                             key=lambda e: (e[1], e[2]))
+            root = ordered[0][0] if ordered else None
             survivors = [h for h, _ in self.recorded_slots()
                          if root is not None and h != root[0]
                          and not self._host_manager.is_blacklisted(h)]
             if root is None or not survivors:
                 log.error("elastic: all %d workers failed with no "
                           "surviving host; stopping job", self._size)
-                self._driver.stop()
+                self._driver.stop(error_message=(
+                    f"all {self._size} elastic worker(s) failed and no "
+                    "healthy host remains to recover on; terminating the "
+                    "job. Check the per-worker logs for the root failure."))
                 return
             log.warning(
                 "elastic: all %d workers failed; treating as a cascade "
@@ -163,7 +182,9 @@ class WorkerStateRegistry:
         if all(self._host_manager.is_blacklisted(h)
                for h, _ in self.recorded_slots()):
             log.error("elastic: every active host is blacklisted; stopping")
-            self._driver.stop()
+            self._driver.stop(error_message=(
+                "every host in the job has been blacklisted after worker "
+                "failures; no host remains to run on. Terminating the job."))
             return
         if self._reset_limit is not None \
                 and self._reset_count >= self._reset_limit:
@@ -173,6 +194,10 @@ class WorkerStateRegistry:
         try:
             self._reset_count += 1
             self._driver.resume(respawn_all=respawn_all)
-        except Exception:
+        except Exception as e:
             log.exception("elastic: failed to resume with new hosts")
-            self._driver.stop()
+            # Without an error message a job whose every worker died before
+            # finishing would report success (empty worker_results).
+            self._driver.stop(error_message=(
+                f"elastic job could not form a new generation after worker "
+                f"failures: {e}"))

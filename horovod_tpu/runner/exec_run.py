@@ -28,7 +28,9 @@ _LOCAL_NAMES = {"localhost", "127.0.0.1", "::1"}
 
 
 def is_local_host(hostname: str) -> bool:
-    if hostname in _LOCAL_NAMES:
+    # The whole 127/8 block is loopback, not just 127.0.0.1 — multi-"host"
+    # single-machine tests use 127.0.0.2 etc. as distinct host identities.
+    if hostname in _LOCAL_NAMES or hostname.startswith("127."):
         return True
     try:
         return hostname in (socket.gethostname(), socket.getfqdn())

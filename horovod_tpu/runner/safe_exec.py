@@ -57,10 +57,18 @@ def safe_exec(command, env: Optional[dict] = None,
               stdout_prefix: str = "",
               stop_event: Optional[threading.Event] = None,
               stdout_file=None,
-              on_line: Optional[Callable[[str], None]] = None) -> int:
+              on_line: Optional[Callable[[str], None]] = None,
+              exit_info: Optional[dict] = None) -> int:
     """Run ``command`` (argv list or shell string); stream output with
     ``stdout_prefix`` per line; kill the whole tree if ``stop_event`` fires.
-    Returns the exit code (negative signal number if signaled)."""
+    Returns the exit code (negative signal number if signaled).
+
+    ``exit_info``, when given, receives ``{"exit_time": <time.time()>}``
+    captured the moment ``wait()`` observes the exit — BEFORE the output
+    pipe drains. The elastic cascade-root heuristic orders failures by
+    these timestamps; the post-drain time would let a root worker with a
+    large unflushed buffer appear to die after a peer killed seconds
+    later."""
     shell = isinstance(command, str)
     proc = subprocess.Popen(
         command, shell=shell, env=env,
@@ -85,5 +93,7 @@ def safe_exec(command, env: Optional[dict] = None,
                     terminate_tree(proc)
                     proc.wait()
                     break
+    if exit_info is not None:
+        exit_info["exit_time"] = time.time()
     fwd.join(timeout=5)
     return proc.returncode

@@ -87,6 +87,11 @@ def _run_eager(nproc: int, quick: bool, timeout: int):
             # sections must still run and MICROBENCH.json must be written
             for q in procs:
                 q.kill()
+            for q in procs:  # reap: no zombies/open pipes during later runs
+                try:
+                    q.communicate(timeout=10)
+                except Exception:
+                    pass
             _log(f"eager {nproc}-proc: timeout after {timeout}s")
             return None
         outs.append(out or "")

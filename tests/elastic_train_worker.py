@@ -2,15 +2,22 @@
 scheduled-discovery integration harness (tests/test_elastic_e2e.py).
 
 Mirrors the reference's test/integration/data training scripts driven by
-elastic_common.py:41-246: trains a fixed number of epochs with per-epoch
-commits, logs every epoch with its (rank, size) so the harness can assert
-which generation ran it, and can kill itself once at a configured
-(rank, epoch) to exercise failure recovery + host blacklisting.
+elastic_common.py:41-246: trains with per-epoch commits, logs every epoch
+with its (rank, size) so the harness can assert which generation ran it,
+and can kill itself at configured (rank, epoch) points to exercise failure
+recovery + host blacklisting.
 
 Env contract from the harness:
-  ELASTIC_TEST_DIR     shared scratch dir (logs + kill marker)
-  ELASTIC_TEST_EPOCHS  total epochs to run
+  ELASTIC_TEST_DIR     shared scratch dir (logs + kill markers)
+  ELASTIC_TEST_EPOCHS  total epochs to run (fixed-length mode)
   ELASTIC_TEST_KILL_RANK / ELASTIC_TEST_KILL_EPOCH  optional one-shot crash
+  ELASTIC_TEST_KILL_SCHEDULE  "rank:epoch,rank:epoch" multi-kill schedule
+      (each fires once, tracked by a per-pair marker file)
+  ELASTIC_TEST_WAIT_FOR_SIZE  event-driven mode: instead of a fixed epoch
+      count, train until hvd.size() >= target is observed, then run two
+      more epochs and finish — the deterministic replacement for sleep-
+      paced scale-up tests (a membership change lands whenever it lands;
+      training simply keeps going until it has).
 """
 
 import os
@@ -35,10 +42,33 @@ EPOCHS = int(os.environ.get("ELASTIC_TEST_EPOCHS", "4"))
 # (elastic_common.py epoch scheduling); without it these tiny epochs
 # complete in milliseconds and no membership event can ever interrupt.
 EPOCH_SLEEP = float(os.environ.get("ELASTIC_TEST_EPOCH_SLEEP", "0.3"))
-KILL_RANK = os.environ.get("ELASTIC_TEST_KILL_RANK")
-KILL_EPOCH = int(os.environ.get("ELASTIC_TEST_KILL_EPOCH", "-1"))
-KILL_MARKER = os.path.join(TEST_DIR, "killed.marker")
+WAIT_FOR_SIZE = int(os.environ.get("ELASTIC_TEST_WAIT_FOR_SIZE", "0"))
+# Event-driven mode 2: train until the harness creates this file. The
+# local check is allreduced (MAX) so every rank stops at the same epoch.
+RUN_UNTIL_FILE = os.environ.get("ELASTIC_TEST_RUN_UNTIL_FILE", "")
+# Hard cap for event-driven mode so a lost membership change fails the
+# test by assertion instead of hanging the launcher until its timeout.
+MAX_EPOCHS = int(os.environ.get("ELASTIC_TEST_MAX_EPOCHS", "200"))
 LOG_PATH = os.path.join(TEST_DIR, "events.log")
+
+
+def _kill_schedule():
+    """[(rank, epoch)] from KILL_SCHEDULE or the legacy single-kill vars."""
+    sched = []
+    raw = os.environ.get("ELASTIC_TEST_KILL_SCHEDULE", "")
+    for part in raw.split(","):
+        part = part.strip()
+        if part:
+            r, _, e = part.partition(":")
+            sched.append((int(r), int(e)))
+    kill_rank = os.environ.get("ELASTIC_TEST_KILL_RANK")
+    if kill_rank is not None:
+        sched.append((int(kill_rank),
+                      int(os.environ.get("ELASTIC_TEST_KILL_EPOCH", "-1"))))
+    return sched
+
+
+KILLS = _kill_schedule()
 
 
 def log_event(msg: str) -> None:
@@ -47,13 +77,49 @@ def log_event(msg: str) -> None:
         f.flush()
 
 
+def maybe_kill(epoch: int) -> None:
+    for rank, kill_epoch in KILLS:
+        if hvd.rank() != rank or epoch != kill_epoch:
+            continue
+        marker = os.path.join(TEST_DIR, f"killed.{rank}.{kill_epoch}.marker")
+        if os.path.exists(marker):
+            continue
+        open(marker, "w").close()
+        log_event(f"killed rank={rank} epoch={epoch}")
+        sys.stdout.flush()
+        os._exit(17)
+
+
 def main():
     hvd.init()
-    state = hvd.elastic.ObjectState(epoch=0, total=0.0)
+    state = hvd.elastic.ObjectState(epoch=0, total=0.0, grown_epoch=-1)
+
+    def finished(state) -> bool:
+        if RUN_UNTIL_FILE:
+            return os.path.exists(RUN_UNTIL_FILE) \
+                or state.epoch >= MAX_EPOCHS
+        if WAIT_FOR_SIZE:
+            if state.grown_epoch < 0 and hvd.size() >= WAIT_FOR_SIZE:
+                state.grown_epoch = state.epoch
+            if state.grown_epoch >= 0 \
+                    and state.epoch >= state.grown_epoch + 2:
+                return True
+            return state.epoch >= MAX_EPOCHS
+        return state.epoch >= EPOCHS
+
+    host = os.environ.get("HVD_TPU_HOSTNAME", "?")
 
     @hvd.elastic.run
     def train(state):
-        while state.epoch < EPOCHS:
+        while True:
+            # Stop decisions from local observations (a sentinel file) can
+            # be seen at different wall times by different ranks; allreduce
+            # the flag so every rank leaves the loop at the same epoch.
+            flag = hvd.allreduce(
+                np.array([1.0 if finished(state) else 0.0], np.float32),
+                op=hvd.Max, name="finish_check")
+            if float(np.asarray(flag)[0]) > 0:
+                break
             time.sleep(EPOCH_SLEEP)
             epoch_sum = 0.0
             for b in range(2):
@@ -61,19 +127,11 @@ def main():
                     np.ones(4, np.float32), op=hvd.Sum,
                     name=f"grad.{b}")
                 epoch_sum = float(np.asarray(out)[0])
-                if (KILL_RANK is not None
-                        and hvd.rank() == int(KILL_RANK)
-                        and state.epoch == KILL_EPOCH
-                        and not os.path.exists(KILL_MARKER)):
-                    open(KILL_MARKER, "w").close()
-                    log_event(f"killed rank={hvd.rank()} "
-                              f"epoch={state.epoch}")
-                    sys.stdout.flush()
-                    os._exit(17)
+                maybe_kill(state.epoch)
             state.total += epoch_sum
             state.epoch += 1
             log_event(f"epoch={state.epoch} rank={hvd.rank()} "
-                      f"size={hvd.size()}")
+                      f"size={hvd.size()} host={host}")
             state.commit()
 
     train(state)

@@ -120,11 +120,15 @@ def test_elastic_fault_tolerance_rank_failure():
 def test_elastic_scale_up_mid_training():
     """Start with one host; add a second mid-run. Workers interrupt at the
     next commit, re-exec into the bigger generation, and later epochs run
-    with size 2 (reference scenario: hosts added)."""
+    with size 2 (reference scenario: hosts added).
+
+    Event-driven: the worker trains until it OBSERVES size 2, then runs two
+    more epochs and finishes — no sleep-tuned discovery window (r3 weak 6).
+    """
     with tempfile.TemporaryDirectory() as td:
         proc, hosts_file = _launch(
-            td, "localhost:1", np_=1, min_np=1, epochs=6,
-            extra_env={"ELASTIC_TEST_EPOCH_SLEEP": "1.5"},
+            td, "localhost:1", np_=1, min_np=1, epochs=0,
+            extra_env={"ELASTIC_TEST_WAIT_FOR_SIZE": "2"},
             extra_args=("--max-np", "2"))
         # wait for training to actually start, then add a host
         deadline = time.time() + 120
@@ -144,7 +148,131 @@ def test_elastic_scale_up_mid_training():
         done = [e for e in events if e.startswith("done rank=0")]
         assert done, events
         m = re.search(r"done rank=0 size=(\d+) epochs=(\d+)", done[0])
-        assert int(m.group(2)) == 6, events
-        # at least one epoch ran in the grown generation
-        assert any(re.match(r"epoch=\d+ rank=\d+ size=2", e)
-                   for e in events), events
+        assert m, done
+        # the run finished IN the grown generation, 2+ epochs after growth
+        assert int(m.group(1)) == 2, events
+        grown = [e for e in events if re.match(r"epoch=\d+ rank=0 size=2", e)]
+        assert len(grown) >= 2, events
+
+
+@pytest.mark.integration
+def test_elastic_all_ranks_failure_recovers_via_cascade():
+    """Kill BOTH ranks in the same epoch (reference scenario: all-ranks
+    failure). The registry treats total generation loss as a cascade rooted
+    at the earliest exit, blacklists only that host, and respawns the rest;
+    the respawned worker restores its durable commit and finishes."""
+    with tempfile.TemporaryDirectory() as td:
+        proc, _ = _launch(
+            td, "localhost:1\n127.0.0.1:1",
+            extra_env={"ELASTIC_TEST_KILL_SCHEDULE": "0:1,1:1"},
+            np_=2, min_np=1, epochs=4)
+        code, out = _finish(proc)
+        events = _events(td)
+        assert code == 0, f"launcher exited {code}:\n{out[-6000:]}\n" \
+                          f"events: {events}"
+        kills = [e for e in events if e.startswith("killed ")]
+        assert len(kills) >= 2, events
+        done = [e for e in events if e.startswith("done ")]
+        assert done, events
+        m = re.search(r"done rank=0 size=(\d+) epochs=(\d+)", done[0])
+        assert m, done
+        assert int(m.group(1)) == 1 and int(m.group(2)) == 4, events
+
+
+@pytest.mark.integration
+def test_elastic_all_hosts_blacklisted_stops_with_error():
+    """Single host whose only worker dies: no host remains, the job stops
+    with a clear error and a nonzero exit (reference scenario: all hosts
+    blacklisted)."""
+    with tempfile.TemporaryDirectory() as td:
+        proc, _ = _launch(
+            td, "localhost:1",
+            extra_env={"ELASTIC_TEST_KILL_RANK": "0",
+                       "ELASTIC_TEST_KILL_EPOCH": "1"},
+            np_=1, min_np=1, epochs=4)
+        code, out = _finish(proc)
+        assert code != 0, f"launcher unexpectedly succeeded:\n{out[-4000:]}"
+        assert "no healthy host remains" in out, out[-4000:]
+
+
+@pytest.mark.integration
+def test_elastic_min_np_timeout():
+    """Discovery never yields the required slots: the launcher gives up
+    after --elastic-timeout with a clear message instead of hanging or
+    tracebacking (reference scenario: min-np timeout)."""
+    with tempfile.TemporaryDirectory() as td:
+        proc, _ = _launch(
+            td, "localhost:1", np_=2, min_np=2, epochs=2,
+            extra_args=("--elastic-timeout", "8"), timeout=120)
+        code, out = _finish(proc, timeout=120)
+        assert code != 0, f"launcher unexpectedly succeeded:\n{out[-4000:]}"
+        assert "Timed out waiting" in out, out[-4000:]
+
+
+@pytest.mark.integration
+def test_elastic_reset_limit_exhaustion():
+    """--reset-limit 0 forbids any reset: the first failure-triggered
+    resume stops the job with the reset-limit message (reference scenario:
+    reset-limit exhaustion)."""
+    with tempfile.TemporaryDirectory() as td:
+        proc, _ = _launch(
+            td, "localhost:1\n127.0.0.1:1",
+            extra_env={"ELASTIC_TEST_KILL_RANK": "1",
+                       "ELASTIC_TEST_KILL_EPOCH": "1"},
+            np_=2, min_np=1, epochs=4,
+            extra_args=("--reset-limit", "0"))
+        code, out = _finish(proc)
+        assert code != 0, f"launcher unexpectedly succeeded:\n{out[-4000:]}"
+        assert "Exceeded the permitted number of elastic resets" in out, \
+            out[-4000:]
+
+
+@pytest.mark.integration
+def test_elastic_hosts_added_and_removed_together():
+    """Replace one host with another in a single discovery change
+    (reference scenario: hosts added and removed). The removed host's
+    worker is torn down, the new host is integrated, and training finishes
+    at full size."""
+    with tempfile.TemporaryDirectory() as td:
+        finish_file = os.path.join(td, "finish.marker")
+        proc, hosts_file = _launch(
+            td, "localhost:1\n127.0.0.1:1", np_=2, min_np=1, epochs=0,
+            extra_env={"ELASTIC_TEST_RUN_UNTIL_FILE": finish_file},
+            extra_args=("--max-np", "2"))
+        # Let the initial 2-host generation make progress, then swap
+        # 127.0.0.1 for 127.0.0.2 in one write.
+        deadline = time.time() + 120
+        while time.time() < deadline:
+            if any(e.startswith("epoch=2 ") for e in _events(td)):
+                break
+            time.sleep(0.5)
+        else:
+            proc.kill()
+            raise AssertionError(f"no progress: {_events(td)}")
+        with open(hosts_file, "w") as f:
+            f.write("localhost:1\n127.0.0.2:1\n")
+        # event-driven: wait until an epoch has RUN on the swapped-in host,
+        # then tell the workers to finish
+        deadline = time.time() + 180
+        while time.time() < deadline:
+            if any("host=127.0.0.2" in e for e in _events(td)):
+                break
+            time.sleep(0.5)
+        else:
+            proc.kill()
+            raise AssertionError(
+                f"swapped-in host never ran an epoch: {_events(td)}")
+        open(finish_file, "w").close()
+        code, out = _finish(proc)
+        events = _events(td)
+        assert code == 0, f"launcher exited {code}:\n{out[-6000:]}\n" \
+                          f"events: {events}"
+        done = [e for e in events if e.startswith("done rank=0")]
+        assert done, events
+        m = re.search(r"done rank=0 size=(\d+) epochs=(\d+)", done[0])
+        assert m and int(m.group(1)) == 2, events
+        # the removed host ran no epochs after the swapped-in host started
+        first_new = next(i for i, e in enumerate(events)
+                         if "host=127.0.0.2" in e)
+        assert not any("host=127.0.0.1" in e
+                       for e in events[first_new:]), events
