@@ -320,6 +320,21 @@ def _check_consistency(w, wm, name, shape, dtype, kind, extra=""):
         words = np.asarray(_local_result(fn(garr))).reshape(-1, 2)
         seqs = [int(x) for x in words[:, 0]]
         fps = [int(x) for x in words[:, 1]]
+        # A joined process replays its last recorded round in lockstep with
+        # active ranks (see the Join section); any mispair while replaying
+        # means the active ranks' per-round collective sequence changed
+        # after join() — a protocol violation worth naming precisely, since
+        # the generic "different sequences" wording sends users hunting
+        # for a data bug that isn't there.
+        join_hint = ""
+        if w.joined:
+            join_hint = (
+                " This process has join()ed and is replaying its last "
+                f"recorded round; the mispaired entry is {name!r} ({kind}, "
+                f"shape {tuple(shape)}, dtype {dtype}). The collective "
+                "round pattern changed after join(): Join requires a "
+                "steady per-round sequence — submit the same collectives "
+                "every step and call join_round() once per step.")
         if len(set(seqs)) > 1:
             raise TensorValidationError(
                 f"Consistency-exchange sequence mismatch at collective "
@@ -327,7 +342,7 @@ def _check_consistency(w, wm, name, shape, dtype, kind, extra=""):
                 f"{dict(enumerate(seqs))} differ, meaning processes have "
                 f"submitted different collective sequences (or their "
                 f"response caches diverged). All processes must submit the "
-                f"same collectives in the same order.")
+                f"same collectives in the same order." + join_hint)
         if len(set(fps)) > 1:
             mine = fps[wm.my_index]
             bad = [i for i, x in enumerate(fps) if x != mine]
@@ -335,7 +350,7 @@ def _check_consistency(w, wm, name, shape, dtype, kind, extra=""):
                 f"Mismatched metadata for collective {name!r} ({kind}): "
                 f"processes {bad} submitted a different shape/dtype/op than "
                 f"process {wm.my_index}. All processes must submit "
-                f"identical requests for the same tensor name.")
+                f"identical requests for the same tensor name." + join_hint)
         cache.put(cache_key)
 
 

@@ -8,6 +8,10 @@ clear error, and the collective plane it would bridge to is the same eager
 host plane used by :mod:`horovod_tpu.torch` — an NDArray bridge
 (asnumpy()/from numpy) is all an MXNet install would need, mirroring the
 torch module's design.
+
+Executed (not just imported) by ``tests/test_mxnet_stub.py``, which drives
+every entry point through a stub ``mxnet`` module exposing the exact
+NDArray/Trainer surface used here.
 """
 
 from typing import Optional
@@ -134,13 +138,20 @@ def DistributedTrainer(params, optimizer, optimizer_params=None,
             super().__init__(params_, optimizer_,
                              optimizer_params_, kvstore=None)
             # the reference divides rescale_grad by size so the allreduce
-            # SUM yields the average (mxnet/__init__.py:95-99)
-            self._scale /= (_basics.size() * gradient_predivide_factor)
+            # SUM yields the average (mxnet/__init__.py:95-99). The
+            # predivide factor must stay numerically NEUTRAL overall: it
+            # moves part of the divide before the summation (overflow
+            # control on narrow dtypes), so the allreduce carries
+            # prescale=1/f and postscale=f — dividing _scale by f here
+            # without the postscale would shrink effective gradients by
+            # 1/f (the torch bridge's prescale/postscale contract).
+            self._scale /= _basics.size()
             self._hvd_predivide = gradient_predivide_factor
 
         def _allreduce_grads(self):
             import numpy as np
             from .. import collectives as _c
+            f = self._hvd_predivide
             live = [(i, p) for i, p in enumerate(self._params)
                     if p.grad_req != "null"]
             if not live:
@@ -150,12 +161,14 @@ def DistributedTrainer(params, optimizer, optimizer_params=None,
                 pairs = [compression.compress(g.asnumpy()) for g in grads]
                 outs = _c.grouped_allreduce(
                     [c for c, _ in pairs], average=False,
+                    prescale_factor=1.0 / f, postscale_factor=f,
                     name="mx.trainer.grads")
                 outs = [compression.decompress(o, ctx)
                         for o, (_, ctx) in zip(outs, pairs)]
             else:
                 outs = _c.grouped_allreduce(
                     [g.asnumpy() for g in grads], average=False,
+                    prescale_factor=1.0 / f, postscale_factor=f,
                     name="mx.trainer.grads")
             for (i, p), out in zip(live, outs):
                 p.list_grad()[0][:] = mx.nd.array(

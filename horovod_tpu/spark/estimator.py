@@ -160,12 +160,26 @@ class HorovodEstimator(EstimatorParams):
         transformer (reference: estimator.py fit / _fit_on_prepared_data)."""
         # validate shared params BEFORE the (possibly expensive) Parquet
         # materialization, identically for every framework subclass
-        if self.validation is not None \
-                and not 0.0 <= float(self.validation) < 1.0:
-            raise ValueError(
-                f"validation must be a fraction in [0, 1), got "
-                f"{self.validation} (reference estimator `validation` "
-                f"param)")
+        if self.validation is not None:
+            try:
+                frac = float(self.validation)
+            except (TypeError, ValueError):
+                # the reference also accepts a validation COLUMN NAME
+                # (rows with col value > 0 form the validation set); this
+                # estimator only implements the fraction form — reject a
+                # non-numeric string early with a targeted message instead
+                # of a bare float() ValueError. Numeric strings ("0.2")
+                # keep working as fractions.
+                raise ValueError(
+                    f"validation={self.validation!r}: column-name "
+                    "validation is not supported by this estimator; pass "
+                    "a fraction in [0, 1) to split the materialized "
+                    "dataset (reference estimator `validation` param).")
+            if not 0.0 <= frac < 1.0:
+                raise ValueError(
+                    f"validation must be a fraction in [0, 1), got "
+                    f"{self.validation} (reference estimator `validation` "
+                    f"param)")
         train_path = self._materialize(df)
         train_fn = self._make_train_fn()
         result = self._run_distributed(train_fn, train_path)

@@ -331,6 +331,8 @@ class _DistributedOptimizer:
         # per-step mutable state
         self._bucket_ready: Dict[int, Dict[int, Any]] = {}
         self._group_handles: list = []
+        self._fired_ids: set = set()   # ids staged into a fired bucket
+        self._should_sync = True
 
     def _threshold(self) -> int:
         if self._fusion_threshold is not None:
@@ -342,6 +344,15 @@ class _DistributedOptimizer:
             return 64 * 1024 * 1024
 
     # hooks ------------------------------------------------------------------
+    def _stage_payload(self, p) -> np.ndarray:
+        """What this parameter contributes to its bucket's collective: the
+        (possibly accumulated) gradient. The Adasum delta subclass stages
+        the local optimizer-step delta instead."""
+        grad = _to_numpy(p.grad)
+        if self._bpps > 1:
+            grad = grad / self._bpps
+        return grad
+
     def _make_hook(self):
         def hook(p):
             n = self._pass_count.get(id(p), 0) + 1
@@ -349,8 +360,9 @@ class _DistributedOptimizer:
             if n >= self._bpps:
                 bid = self._bucket_of[id(p)]
                 ready = self._bucket_ready.setdefault(bid, {})
-                if id(p) in ready or any(
-                        p is q for h, ps in self._group_handles for q in ps):
+                # O(1) duplicate-fire guard (fired-bucket membership is
+                # tracked as a set of ids, not rescanned per hook)
+                if id(p) in ready or id(p) in self._fired_ids:
                     raise AssertionError(
                         "Gradients were computed more than "
                         "backward_passes_per_step times before call to "
@@ -358,12 +370,10 @@ class _DistributedOptimizer:
                         "accumulate gradients locally (reference: "
                         "torch/optimizer.py:122-126).")
                 self._pass_count[id(p)] = 0
-                grad = _to_numpy(p.grad)
-                if self._bpps > 1:
-                    grad = grad / self._bpps
                 # compress on the wire (reference: torch/optimizer.py:111-117
                 # compression hook); decompressed in synchronize()
-                compressed, ctx = self._compression.compress(grad)
+                compressed, ctx = self._compression.compress(
+                    self._stage_payload(p))
                 self._ctxs[p] = ctx
                 ready[id(p)] = compressed
                 if len(ready) == len(self._bucket_members[bid]):
@@ -394,10 +404,17 @@ class _DistributedOptimizer:
                  f"{len(members)}of{len(self._bucket_members[bid])}"
                  f".{digest:08x}")
         self._group_handles.append((h, members))
+        self._fired_ids.update(id(p) for p in members)
 
     # torch optimizer protocol ----------------------------------------------
-    def synchronize(self):
+    def _apply_result(self, p, out) -> None:
+        """Land a reduced bucket member: the base optimizer overwrites the
+        gradient; the Adasum delta subclass advances the parameter."""
         import torch
+        with torch.no_grad():
+            p.grad.copy_(_from_numpy(out, p.grad.dtype))
+
+    def _flush_and_drain(self):
         # Flush partially-ready buckets (params whose peers produced no
         # gradient this step, e.g. frozen or unused branches). The partial
         # count is part of the collective name, so processes diverging in
@@ -414,13 +431,33 @@ class _DistributedOptimizer:
             for p, out in zip(members, outs):
                 out = self._compression.decompress(
                     out, self._ctxs.pop(p, None))
-                with torch.no_grad():
-                    p.grad.copy_(_from_numpy(out, p.grad.dtype))
+                self._apply_result(p, out)
         self._group_handles = []
         self._bucket_ready = {}
+        self._fired_ids = set()
+
+    def synchronize(self):
+        self._flush_and_drain()
+
+    def skip_synchronize(self):
+        """Context manager: make the next ``step()`` skip its implicit
+        ``synchronize()`` — for callers that synchronized manually to
+        modify gradients in place (reference: torch/optimizer.py
+        skip_synchronize + gradient-clipping recipe)."""
+        import contextlib
+
+        @contextlib.contextmanager
+        def ctx():
+            self._should_sync = False
+            try:
+                yield
+            finally:
+                self._should_sync = True
+        return ctx()
 
     def step(self, closure=None):
-        self.synchronize()
+        if self._should_sync:
+            self.synchronize()
         return self._opt.step(closure)
 
     def zero_grad(self, *a, **kw):
@@ -444,11 +481,127 @@ class _DistributedOptimizer:
         return getattr(self._opt, item)
 
 
+class _DistributedAdasumDeltaOptimizer(_DistributedOptimizer):
+    """Adasum on optimizer DELTAS rather than gradients (reference
+    behavior: _DistributedAdasumOptimizer, torch/optimizer.py:196-364;
+    pairwise rule adasum.h:385-396): each worker steps its wrapped
+    optimizer locally against its own gradient, the resulting parameter
+    delta (``-lr*f(g)``) is Adasum-combined across workers, and the
+    parameters advance by the combined delta — the scale-invariant rule
+    then automatically balances workers whose learning rates or gradient
+    magnitudes differ.
+
+    TPU-shaped implementation: shares the base class's bucket planning and
+    membership-digest naming, but stages deltas (computed by restricting
+    the inner optimizer's ``param_groups`` to the one ready parameter and
+    stepping it) and applies the combined delta to ``p.data`` in
+    ``step()``; the inner optimizer has already consumed the gradient.
+    """
+
+    def __init__(self, optimizer, named_parameters=None,
+                 backward_passes_per_step: int = 1,
+                 compression=Compression.none,
+                 fusion_threshold_bytes: Optional[int] = None):
+        super().__init__(
+            optimizer, named_parameters=named_parameters, op=_c.Adasum,
+            backward_passes_per_step=backward_passes_per_step,
+            compression=compression,
+            fusion_threshold_bytes=fusion_threshold_bytes)
+        self._start: Dict[int, Any] = {}   # id(p) -> pre-step scratch copy
+
+    def _stage_payload(self, p) -> np.ndarray:
+        return _to_numpy(self._local_delta(p))
+
+    def _local_delta(self, p):
+        """-lr*f(g) for this parameter: snapshot, step the inner optimizer
+        on p alone, measure the movement, and roll p back (parameters only
+        advance in ``step()``, by the globally combined delta)."""
+        import torch
+        with torch.no_grad():
+            start = self._start.get(id(p))
+            if start is None:
+                start = self._start[id(p)] = torch.empty_like(p.data)
+            start.copy_(p.data)
+        stash = []
+        for g in self._opt.param_groups:
+            stash.append(g["params"])
+            g["params"] = [q for q in g["params"] if q is p]
+        try:
+            self._opt.step()
+        finally:
+            for s, g in zip(stash, self._opt.param_groups):
+                g["params"] = s
+        with torch.no_grad():
+            delta = p.data - start
+            p.data.copy_(start)
+        return delta
+
+    def synchronize(self):
+        # Deltas can only be applied together with the parameter advance in
+        # step(); a standalone synchronize has nothing meaningful to expose
+        # (reference: _DistributedAdasumOptimizer.synchronize is a no-op).
+        pass
+
+    def skip_synchronize(self):
+        raise AssertionError(
+            "skip_synchronize is not supported with the Adasum delta "
+            "optimizer: deltas are reduced and applied inside step().")
+
+    def _apply_result(self, p, out) -> None:
+        import torch
+        with torch.no_grad():
+            p.data.add_(_from_numpy(out, p.dtype))
+
+    def step(self, closure=None):
+        loss = closure() if closure is not None else None
+        # Parameters whose hooks never fired this step (e.g. an unused
+        # branch still carrying a stale gradient) contribute their delta
+        # now so every process issues identical collectives (reference:
+        # step()'s missing_p path).
+        staged = {pid for ready in self._bucket_ready.values()
+                  for pid in ready}
+        for p in self._names:
+            if id(p) in self._fired_ids or id(p) in staged:
+                continue
+            if p.grad is None:
+                continue
+            bid = self._bucket_of[id(p)]
+            ready = self._bucket_ready.setdefault(bid, {})
+            compressed, ctx = self._compression.compress(
+                self._stage_payload(p))
+            self._ctxs[p] = ctx
+            ready[id(p)] = compressed
+        self._flush_and_drain()
+        return loss
+
+    def zero_grad(self, *a, **kw):
+        if self._group_handles or any(self._bucket_ready.values()):
+            raise AssertionError(
+                "optimizer.zero_grad() was called after loss.backward() "
+                "but before optimizer.step(); with the Adasum delta "
+                "optimizer this races with the in-flight delta reduction "
+                "(reference: torch/optimizer.py zero_grad guard).")
+        return self._opt.zero_grad(*a, **kw)
+
+
 def DistributedOptimizer(optimizer, named_parameters=None, op=_c.Average,
                          backward_passes_per_step: int = 1,
                          compression=Compression.none,
                          gradient_predivide_factor: float = 1.0,
                          fusion_threshold_bytes: Optional[int] = None):
+    if op == _c.Adasum and _basics.size() > 1:
+        # Reference dispatch (torch/optimizer.py:412-420): op=Adasum with a
+        # multi-process world means the DELTA optimizer; a single process
+        # keeps the plain gradient path (Adasum of one tensor = identity).
+        if gradient_predivide_factor != 1.0:
+            raise ValueError(
+                "gradient_predivide_factor only applies to op=Average "
+                "(reference: torch/optimizer.py:395-398)")
+        return _DistributedAdasumDeltaOptimizer(
+            optimizer, named_parameters=named_parameters,
+            backward_passes_per_step=backward_passes_per_step,
+            compression=compression,
+            fusion_threshold_bytes=fusion_threshold_bytes)
     return _DistributedOptimizer(
         optimizer, named_parameters=named_parameters, op=op,
         backward_passes_per_step=backward_passes_per_step,

@@ -1,0 +1,77 @@
+"""2-process numerics check of the torch Adasum DELTA optimizer.
+
+The reference validates Adasum by recomputing the pairwise rule in numpy
+and comparing against the framework result
+(/root/reference/test/test_adasum_pytorch.py). Here: both ranks hold the
+same initial parameter, produce rank-dependent gradients, and step the
+delta optimizer (SGD+momentum inner); the harness replays the exact
+per-rank inner-optimizer math and the Adasum combination
+(adasum.h:385-396 rule) in numpy and asserts the parameter trajectory
+matches on every rank for several steps.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.pop("XLA_FLAGS", None)
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+import torch  # noqa: E402
+
+import horovod_tpu.torch as hvd  # noqa: E402
+
+
+def adasum_np(a, b):
+    dot = float(np.sum(a * b))
+    na = float(np.sum(a * a))
+    nb = float(np.sum(b * b))
+    ca = 0.0 if na == 0 else 1.0 - dot / (2 * na)
+    cb = 0.0 if nb == 0 else 1.0 - dot / (2 * nb)
+    return ca * a + cb * b
+
+
+def main():
+    hvd.init()
+    r, n = hvd.rank(), hvd.size()
+    assert n == 2, f"this worker expects 2 processes, got {n}"
+
+    lr, mu = 0.1, 0.9
+    p0 = (np.arange(6, dtype=np.float32).reshape(2, 3) / 10.0) + 1.0
+    p = torch.nn.Parameter(torch.from_numpy(p0.copy()))
+    opt = torch.optim.SGD([p], lr=lr, momentum=mu)
+    dopt = hvd.DistributedOptimizer(
+        opt, named_parameters=[("p", p)], op=hvd.Adasum)
+    assert type(dopt).__name__ == "_DistributedAdasumDeltaOptimizer", \
+        type(dopt)
+
+    expected = p0.copy()
+    bufs = {0: None, 1: None}   # per-rank momentum buffers, replayed locally
+    for step in range(3):
+        coeff = (r + 1.0) * (step + 1.0)
+        dopt.zero_grad()
+        loss = (p * coeff).sum()
+        loss.backward()
+        dopt.step()
+
+        # replay both ranks' inner SGD(momentum) deltas + the Adasum rule
+        deltas = []
+        for rank_i in (0, 1):
+            g = np.full_like(p0, (rank_i + 1.0) * (step + 1.0))
+            bufs[rank_i] = g if bufs[rank_i] is None \
+                else mu * bufs[rank_i] + g
+            deltas.append(-lr * bufs[rank_i])
+        expected = expected + adasum_np(deltas[0], deltas[1])
+
+        got = p.detach().numpy()
+        np.testing.assert_allclose(got, expected, rtol=1e-5, atol=1e-6)
+
+    print(f"adasum torch worker {r} OK")
+    hvd.shutdown()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,62 @@
+"""Join protocol-violation worker (2 processes).
+
+Rank 0 join()s after one round of [allreduce grad.a]; rank 1 then CHANGES
+its per-round collective pattern (submits grad.b). Rank 0's replay
+mispairs with rank 1's submission and both ranks must raise
+TensorValidationError — and the joined rank's error must say precisely
+that the round pattern changed after join() and name the mispaired entry
+(VERDICT r3 item 8), instead of the generic different-sequences wording.
+
+The response cache is disabled so every collective runs the metadata
+exchange — the mispair is then detected deterministically at the first
+divergent collective rather than via the stall backstop.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.pop("XLA_FLAGS", None)
+os.environ["HVD_TPU_CACHE_CAPACITY"] = "0"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+import horovod_tpu as hvd  # noqa: E402
+from horovod_tpu.exceptions import TensorValidationError  # noqa: E402
+
+
+def main():
+    hvd.init()
+    r = hvd.rank()
+    assert hvd.size() == 2
+
+    # round 1: identical pattern on both ranks
+    hvd.allreduce(np.ones(4, np.float32), op=hvd.Sum, name="grad.a")
+    hvd.join_round()
+
+    try:
+        if r == 0:
+            hvd.join()   # replays [grad.a] per round until all joined
+        else:
+            # protocol violation: round 2's collective differs from round 1
+            hvd.allreduce(np.ones(4, np.float32), op=hvd.Sum, name="grad.b")
+            hvd.join_round()
+            hvd.join()
+        print(f"rank {r}: NO ERROR")
+    except TensorValidationError as e:
+        msg = str(e)
+        if r == 0:
+            assert "round pattern changed after join()" in msg, msg
+            assert "grad.a" in msg, msg
+            assert "join_round()" in msg, msg
+            print("rank 0: JOIN HINT OK")
+        else:
+            print("rank 1: CAUGHT OK")
+    hvd.shutdown()
+
+
+if __name__ == "__main__":
+    main()
