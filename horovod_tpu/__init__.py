@@ -41,6 +41,9 @@ from .collectives import (  # noqa: F401
     alltoall, alltoall_async,
     poll, synchronize, release, join, join_round, joined, barrier,
 )
+from .timeline import (  # noqa: F401
+    start_jax_profiler, stop_jax_profiler,
+)
 from .exceptions import (  # noqa: F401
     HorovodInternalError, HostsUpdatedInterrupt, TensorValidationError,
     DuplicateNameError, NotInitializedError, StallError,

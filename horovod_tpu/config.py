@@ -217,25 +217,34 @@ class Config:
         self._overrides[name] = value
 
     def get(self, name: str) -> Any:
+        return self.resolve(name)[0]
+
+    def resolve(self, name: str) -> "tuple[Any, str]":
+        """(value, source) with source one of 'override',
+        'env HVD_TPU_<N>', 'env <alias>', 'scheduler <VAR>', 'default'.
+        ``describe()`` prints this, so provenance can never drift from the
+        actual resolution order."""
         knob = _REGISTRY[name]
         if name in self._overrides:
-            return self._overrides[name]
+            return self._overrides[name], "override"
         raw = os.environ.get("HVD_TPU_" + knob.name)
+        src = "env HVD_TPU_" + knob.name
         for alias in knob.aliases():
             if raw is not None:
                 break
             raw = os.environ.get(alias)
+            src = f"env {alias}"
         if raw is None:
             # external-scheduler fallback for the task-identity knobs
             if name in (RANK, SIZE, LOCAL_RANK, LOCAL_SIZE):
                 ident = mpi_task_identity()
                 if name in ident:
-                    return ident[name]
-            return knob.default
+                    return ident[name], "scheduler"
+            return knob.default, "default"
         try:
-            return knob.parser(raw)
+            return knob.parser(raw), src
         except (TypeError, ValueError):
-            return knob.default
+            return knob.default, "default"
 
     def snapshot(self) -> Dict[str, Any]:
         return {name: self.get(name) for name in _REGISTRY}
@@ -244,3 +253,19 @@ class Config:
 def knobs() -> Dict[str, Knob]:
     """All registered knobs (used by the launcher to build CLI flags)."""
     return dict(_REGISTRY)
+
+
+def describe(cfg: Optional[Config] = None) -> str:
+    """Human-readable dump of every knob's LIVE value and where it came
+    from (override / env / alias env / default) — the first thing to
+    check when a setting seems ignored. Uses the active world's Config
+    when one exists, else a fresh env-only view."""
+    if cfg is None:
+        from . import basics
+        w = basics.world() if basics.is_initialized() else None
+        cfg = w.config if w is not None else Config()
+    lines = []
+    for name, knob in _REGISTRY.items():
+        value, src = cfg.resolve(name)
+        lines.append(f"{'HVD_TPU_' + knob.name:44s} = {value!r:24} [{src}]")
+    return "\n".join(lines)

@@ -231,16 +231,18 @@ def _run_jsrun(args) -> int:
         # The JAX coordinator is BOUND by rank 0, which jsrun places on the
         # first compute host — not on this batch host (same rule as
         # _run_static's slots[0].hostname). A free_port() probe here would
-        # test availability on the WRONG machine, so pick from the dynamic/
-        # ephemeral range instead: IANA reserves 49152-65535 for exactly
-        # this, and a stable digest of the LSF job id de-conflicts
-        # concurrent jobs sharing a compute node (builtin hash() is salted
-        # per interpreter and would not be stable).
+        # test availability on the WRONG machine, so pick deterministically
+        # from 61000-65499: ABOVE Linux's default ephemeral outgoing range
+        # (32768-60999), so a random outgoing connection on the compute
+        # host cannot squat the port — only another long-lived listener
+        # can. A stable crc32 of the LSF job id de-conflicts concurrent
+        # jobs sharing a compute node (builtin hash() is salted per
+        # interpreter and would not be stable).
         import zlib
         coord_host = slots[0].hostname if slots else socket.gethostname()
         seed = os.environ.get("LSB_JOBID", str(os.getpid()))
-        coord_port = 49152 + (zlib.crc32(
-            f"hvd-tpu-coord-{seed}".encode()) % 16000)
+        coord_port = 61000 + (zlib.crc32(
+            f"hvd-tpu-coord-{seed}".encode()) % 4500)
         base_env["HVD_TPU_COORDINATOR_ADDR"] = f"{coord_host}:{coord_port}"
         base_env["HVD_TPU_SIZE"] = str(np)
         base_env["HVD_TPU_RENDEZVOUS_ADDR"] = socket.gethostname()

@@ -220,3 +220,19 @@ def maybe_start_timeline(world) -> object:
     if not path or world.process_id != 0:
         return NULL_TIMELINE
     return Timeline(path, world.config.get(_config.TIMELINE_MARK_CYCLES))
+
+
+def start_jax_profiler(logdir: str) -> None:
+    """Capture an XLA device trace (TensorBoard/Perfetto format) alongside
+    the host timeline; both use host-clock timestamps so spans line up.
+    The host timeline shows when the framework did what; this shows what
+    the devices were doing meanwhile (the split the reference handles
+    with CUDA events waited by the finalizer thread,
+    gpu_operations.h:105-114)."""
+    import jax
+    jax.profiler.start_trace(logdir)
+
+
+def stop_jax_profiler() -> None:
+    import jax
+    jax.profiler.stop_trace()

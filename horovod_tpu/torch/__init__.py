@@ -571,6 +571,11 @@ class _DistributedAdasumDeltaOptimizer(_DistributedOptimizer):
                 self._stage_payload(p))
             self._ctxs[p] = ctx
             ready[id(p)] = compressed
+            # Reset accumulation like the hook path does (reference:
+            # step() resets _allreduce_delay for every handled param,
+            # optimizer.py:355) — otherwise with bpps>1 a partially
+            # accumulated param fires early next step.
+            self._pass_count[id(p)] = 0
         self._flush_and_drain()
         return loss
 

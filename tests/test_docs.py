@@ -60,3 +60,18 @@ def test_documented_top_level_api_exists():
     from horovod_tpu import compiled_autotune
     assert hasattr(compiled_autotune, "autotune_variants")
     assert hasattr(compiled_autotune, "tune_distributed_step")
+
+
+def test_configuration_doc_covers_every_knob():
+    """docs/configuration.md is generated from the knob registry; a knob
+    added without regenerating the table should fail here, not drift."""
+    import os
+    from horovod_tpu import config
+    path = os.path.join(os.path.dirname(__file__), "..", "docs",
+                        "configuration.md")
+    with open(path) as f:
+        text = f.read()
+    for knob in config.knobs().values():
+        assert f"HVD_TPU_{knob.name}" in text, (
+            f"knob HVD_TPU_{knob.name} missing from docs/configuration.md "
+            f"— regenerate the table (see the file header)")

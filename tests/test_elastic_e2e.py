@@ -170,8 +170,14 @@ def test_elastic_all_ranks_failure_recovers_via_cascade():
         events = _events(td)
         assert code == 0, f"launcher exited {code}:\n{out[-6000:]}\n" \
                           f"events: {events}"
+        # Both ranks are scheduled to self-kill at epoch 1, but the second
+        # may instead be killed by the coordination service's peer-death
+        # propagation before reaching its own kill point (a real cascade —
+        # which is the all-failed path this scenario exists to exercise;
+        # both deaths are recorded as FAILURE either way). So require at
+        # least one self-kill event, not two.
         kills = [e for e in events if e.startswith("killed ")]
-        assert len(kills) >= 2, events
+        assert len(kills) >= 1, events
         done = [e for e in events if e.startswith("done ")]
         assert done, events
         m = re.search(r"done rank=0 size=(\d+) epochs=(\d+)", done[0])
