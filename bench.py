@@ -222,10 +222,13 @@ def worker_main(cpu: bool, batch_override=None):
             # Stages 2-3: large batches with the SCANNED k-step program
             # (one XLA call per timed iteration — no per-step host
             # dispatch in the measurement), re-printing improved lines.
-            # Each costs a fresh compile. r4 measurement on a live v5e:
-            # batch 32→1694, 64→1866, 128→2309 img/s (mfu 0.21/0.23/0.28)
-            # — the intermediate sizes are not worth their compiles, so
-            # the ladder jumps straight to the MFU-bearing batches.
+            # Each costs a fresh compile. r4 measurements on a live v5e:
+            # batch 32→1694, 64→1866, 128→2372, 256→2405 img/s
+            # (mfu 0.21/0.23/0.28/0.30) — intermediate sizes are not
+            # worth their compiles, so the ladder jumps straight to the
+            # MFU-bearing batches. 512 was probed and rejected: its
+            # compile alone exceeds 420 s on v5e (HBM-pressure layout
+            # search), so it can never pay for itself within the budget.
             dict(batch_per_chip=128, num_warmup_batches=5,
                  num_batches_per_iter=10, num_iters=10, scanned=True),
             dict(batch_per_chip=256, num_warmup_batches=5,

@@ -125,10 +125,12 @@ _MPI_FAMILIES = (
 )
 
 
-def mpi_task_identity(environ=None) -> Dict[str, int]:
+def mpi_task_identity(environ=None, with_source: bool = False):
     """{"RANK": r, "SIZE": n, ...} from the first coherent scheduler
     family, or {} when none applies. Shared by Config.get's fallback and
-    the jsrun shim (runner/lsf.py) so the mapping lives in one place."""
+    the jsrun shim (runner/lsf.py) so the mapping lives in one place.
+    ``with_source=True`` returns ``(mapping, rank_var)`` instead, so
+    provenance reporting can name the scheduler variable that matched."""
     env = os.environ if environ is None else environ
 
     def parse(v):
@@ -151,8 +153,8 @@ def mpi_task_identity(environ=None) -> Dict[str, int]:
                     out[key] = parse(v)
                 except ValueError:
                     pass
-        return out
-    return {}
+        return (out, rank_var) if with_source else out
+    return ({}, None) if with_source else {}
 CROSS_RANK = _register("CROSS_RANK", -1, int, alias="HOROVOD_CROSS_RANK")
 CROSS_SIZE = _register("CROSS_SIZE", -1, int, alias="HOROVOD_CROSS_SIZE")
 HOSTNAME = _register("HOSTNAME", "", str, alias="HOROVOD_HOSTNAME")
@@ -237,9 +239,9 @@ class Config:
         if raw is None:
             # external-scheduler fallback for the task-identity knobs
             if name in (RANK, SIZE, LOCAL_RANK, LOCAL_SIZE):
-                ident = mpi_task_identity()
+                ident, family = mpi_task_identity(with_source=True)
                 if name in ident:
-                    return ident[name], "scheduler"
+                    return ident[name], f"scheduler {family}"
             return knob.default, "default"
         try:
             return knob.parser(raw), src

@@ -151,3 +151,34 @@ def test_mpi_env_rank_detection():
             assert cfg.get(_config.RANK) == 5
             with mock.patch.dict("os.environ", {"HVD_TPU_RANK": "6"}):
                 assert cfg.get(_config.RANK) == 6
+
+
+def test_config_describe_provenance(monkeypatch):
+    """describe() reports the live value AND its true source for every
+    knob (docs/configuration.md points debugging at it)."""
+    from horovod_tpu import config
+
+    monkeypatch.setenv("HVD_TPU_FUSION_THRESHOLD", "1048576")
+    monkeypatch.setenv("HOROVOD_CACHE_CAPACITY", "7")
+    out = config.Config()
+    text = config.describe(out)
+    lines = {l.split()[0]: l for l in text.splitlines()}
+    assert "[env HVD_TPU_FUSION_THRESHOLD]" in lines["HVD_TPU_FUSION_THRESHOLD"]
+    assert "1048576" in lines["HVD_TPU_FUSION_THRESHOLD"]
+    assert "[env HOROVOD_CACHE_CAPACITY]" in lines["HVD_TPU_CACHE_CAPACITY"]
+    out.set("CYCLE_TIME", 9.5)
+    lines2 = {l.split()[0]: l for l in config.describe(out).splitlines()}
+    assert "[override]" in lines2["HVD_TPU_CYCLE_TIME"]
+    assert len(text.splitlines()) == len(config.knobs())
+
+
+def test_jax_profiler_helpers(tmp_path):
+    import jax.numpy as jnp
+    import jax
+    import horovod_tpu as hvd
+
+    hvd.start_jax_profiler(str(tmp_path))
+    jax.jit(lambda x: x + 1)(jnp.ones(4)).block_until_ready()
+    hvd.stop_jax_profiler()
+    files = list(tmp_path.rglob("*"))
+    assert files, "profiler produced no trace files"

@@ -275,3 +275,41 @@ def test_gradient_predivide_factor(hvd_world):
             torch.optim.SGD(m.parameters(), lr=0.1),
             named_parameters=m.named_parameters(),
             op=hvd_t.Sum, gradient_predivide_factor=2.0)
+
+
+def test_skip_synchronize_gradient_clipping_recipe(hvd_world):
+    """The documented clipping recipe (reference torch/optimizer.py
+    skip_synchronize): synchronize manually, clip in place, then step
+    without a second synchronize — the inner optimizer must consume the
+    CLIPPED gradients."""
+    import horovod_tpu.torch as hvd_t
+
+    p = torch.nn.Parameter(torch.zeros(4))
+    opt = hvd_t.DistributedOptimizer(
+        torch.optim.SGD([p], lr=1.0), named_parameters=[("p", p)])
+    loss = (p * 100.0).sum()
+    loss.backward()
+    opt.synchronize()
+    torch.nn.utils.clip_grad_norm_([p], max_norm=1.0)
+    with opt.skip_synchronize():
+        opt.step()
+    # lr=1, clipped grad norm 1 => |p| == grad/||grad|| elementwise
+    np.testing.assert_allclose(
+        p.detach().numpy(), -np.full(4, 0.5), rtol=1e-6)
+    # flag restored: the next step synchronizes again
+    assert opt._should_sync is True
+
+
+def test_adasum_delta_optimizer_single_process_passthrough(hvd_world):
+    """op=Adasum with one process keeps the plain gradient optimizer
+    (reference factory dispatch: size()==1 -> gradient path)."""
+    import horovod_tpu.torch as hvd_t
+
+    p = torch.nn.Parameter(torch.zeros(2))
+    opt = hvd_t.DistributedOptimizer(
+        torch.optim.SGD([p], lr=0.1), named_parameters=[("p", p)],
+        op=hvd_t.Adasum)
+    assert type(opt).__name__ == "_DistributedOptimizer"
+    (p.sum()).backward()
+    opt.step()
+    np.testing.assert_allclose(p.detach().numpy(), -0.1, rtol=1e-6)
