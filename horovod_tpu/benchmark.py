@@ -105,8 +105,13 @@ class _Rig:
         batch_sharding = NamedSharding(mesh, P("dp"))
         replicated = NamedSharding(mesh, P())
 
+        import os
+        # Math-equivalent MXU-friendly stem (models/resnet.py
+        # SpaceToDepthStem); numerics-tested equal, so using it is a
+        # layout optimization, not a model change.
+        stem = os.environ.get("HVD_TPU_BENCH_STEM", "conv")
         model = {"resnet50": ResNet50, "resnet18": ResNet18}[model_name](
-            num_classes=1000)
+            num_classes=1000, stem=stem)
 
         rng = jax.random.PRNGKey(0)
         self.images = jax.device_put(

@@ -76,24 +76,146 @@ def _from_numpy(a, dtype):
     return t.to(dtype) if t.dtype != dtype else t
 
 
+# -- differentiable collectives (reference: the autograd Functions of
+#    torch/mpi_ops.py:144-157, 290-308, 375-389 — allreduce's gradient is
+#    the same allreduce of the upstream gradient; allgather's is a
+#    sum-allreduce narrowed to this process's rows; broadcast's is a
+#    sum-allreduce delivered to the root and zero elsewhere). Built
+#    lazily so importing this module never requires torch. ---------------
+
+_autograd_cache: Dict[str, Any] = {}
+
+
+def _autograd_fns():
+    fns = _autograd_cache.get("fns")
+    if fns is not None:
+        return fns
+    import torch
+
+    class _AllreduceFn(torch.autograd.Function):
+        @staticmethod
+        def forward(ctx, tensor, op, prescale, postscale, name,
+                    compression):
+            ctx.op, ctx.pre, ctx.post = op, prescale, postscale
+            ctx.compression = compression
+            compressed, cc = compression.compress(_to_numpy(tensor))
+            out = _c.allreduce(compressed, op=op, name=name,
+                               prescale_factor=prescale,
+                               postscale_factor=postscale)
+            return _from_numpy(compression.decompress(out, cc),
+                               tensor.dtype)
+
+        @staticmethod
+        def backward(ctx, grad):
+            # compression is wire-level (numpy boundary), so the backward
+            # pass compresses its traffic too and gradients still flow
+            compressed, cc = ctx.compression.compress(_to_numpy(grad))
+            out = _c.allreduce(compressed, op=ctx.op,
+                               prescale_factor=ctx.pre,
+                               postscale_factor=ctx.post)
+            return (_from_numpy(ctx.compression.decompress(out, cc),
+                                grad.dtype),
+                    None, None, None, None, None)
+
+    class _AllgatherFn(torch.autograd.Function):
+        @staticmethod
+        def forward(ctx, tensor, name):
+            ctx.was_scalar = tensor.ndim == 0
+            ctx.dim0 = int(tensor.shape[0]) if tensor.ndim else 1
+            out = _c.allgather(_to_numpy(tensor), name=name)
+            return _from_numpy(out, tensor.dtype)
+
+        @staticmethod
+        def backward(ctx, grad):
+            reduced = np.asarray(
+                _c.allreduce(_to_numpy(grad), op=_c.Sum))
+            dims = np.asarray(_c.allgather(
+                np.array([ctx.dim0], np.int64))).reshape(-1)
+            offset = int(dims[:_basics.rank()].sum())
+            if reduced.ndim == 0:
+                # size-1 world gathering a scalar: the gathered result
+                # (and so its gradient) is itself 0-d
+                piece = reduced
+            else:
+                piece = reduced[offset:offset + ctx.dim0]
+                if ctx.was_scalar:
+                    piece = piece.reshape(())
+            return _from_numpy(piece, grad.dtype), None
+
+    class _BroadcastFn(torch.autograd.Function):
+        @staticmethod
+        def forward(ctx, tensor, root_rank, name):
+            ctx.root_rank = root_rank
+            out = _c.broadcast(_to_numpy(tensor), root_rank=root_rank,
+                               name=name)
+            return _from_numpy(out, tensor.dtype)
+
+        @staticmethod
+        def backward(ctx, grad):
+            reduced = _from_numpy(
+                _c.allreduce(_to_numpy(grad), op=_c.Sum), grad.dtype)
+            if _basics.rank() != ctx.root_rank:
+                reduced = reduced * 0
+            return reduced, None, None
+
+    fns = {"allreduce": _AllreduceFn, "allgather": _AllgatherFn,
+           "broadcast": _BroadcastFn}
+    _autograd_cache["fns"] = fns
+    return fns
+
+
 def allreduce(tensor, average=None, name: Optional[str] = None, op=None,
-              prescale_factor: float = 1.0, postscale_factor: float = 1.0):
+              prescale_factor: float = 1.0, postscale_factor: float = 1.0,
+              compression=Compression.none):
     """Synchronous allreduce of a torch tensor; returns a torch tensor
-    (reference: torch/mpi_ops.py:158-200)."""
-    out = _c.allreduce(_to_numpy(tensor), average=average, name=name, op=op,
+    (reference: torch/mpi_ops.py:158-224). Differentiable: when
+    ``tensor.requires_grad``, gradients flow via an allreduce of the
+    upstream gradient; compression is wire-level (applied at the numpy
+    boundary inside the autograd Function, forward AND backward), so it
+    never detaches the graph."""
+    if getattr(tensor, "requires_grad", False):
+        op_r = _c._resolve_op(average, op)
+        return _autograd_fns()["allreduce"].apply(
+            tensor, op_r, prescale_factor, postscale_factor, name,
+            compression)
+    compressed, cctx = compression.compress(_to_numpy(tensor))
+    out = _c.allreduce(compressed, average=average, name=name, op=op,
                        prescale_factor=prescale_factor,
                        postscale_factor=postscale_factor)
+    out = compression.decompress(out, cctx)
     return _from_numpy(out, tensor.dtype)
 
 
+def allreduce_(tensor, average=None, name: Optional[str] = None, op=None,
+               prescale_factor: float = 1.0, postscale_factor: float = 1.0):
+    """In-place allreduce: ``tensor`` holds the reduced value on return
+    (reference: torch/mpi_ops.py:225-253 allreduce_)."""
+    return synchronize(allreduce_async_(
+        tensor, average=average, name=name, op=op,
+        prescale_factor=prescale_factor, postscale_factor=postscale_factor))
+
+
 def allgather(tensor, name: Optional[str] = None):
+    """Concatenate along dim 0 across processes; differentiable like the
+    reference (torch/mpi_ops.py:290-336)."""
+    if getattr(tensor, "requires_grad", False):
+        return _autograd_fns()["allgather"].apply(tensor, name)
     out = _c.allgather(_to_numpy(tensor), name=name)
     return _from_numpy(out, tensor.dtype)
 
 
 def broadcast(tensor, root_rank: int, name: Optional[str] = None):
+    """Broadcast from ``root_rank``; differentiable like the reference
+    (torch/mpi_ops.py:375-439)."""
+    if getattr(tensor, "requires_grad", False):
+        return _autograd_fns()["broadcast"].apply(tensor, root_rank, name)
     out = _c.broadcast(_to_numpy(tensor), root_rank=root_rank, name=name)
     return _from_numpy(out, tensor.dtype)
+
+
+def broadcast_(tensor, root_rank: int, name: Optional[str] = None):
+    """In-place broadcast (reference: torch/mpi_ops.py:440-462)."""
+    return synchronize(broadcast_async_(tensor, root_rank, name=name))
 
 
 def alltoall(tensor, splits=None, name: Optional[str] = None):
@@ -108,14 +230,16 @@ _handle_meta_lock = threading.Lock()
 _HANDLE_META_CAP = 4096
 
 
-def _remember_handle(h: int, dtype) -> int:
-    """Track a handle's torch dtype, reclaiming abandoned handles.
+def _remember_handle(h: int, dtype, target=None) -> int:
+    """Track a handle's torch dtype (and, for the in-place ``*_``
+    variants, the tensor to copy the result into at synchronize time),
+    reclaiming abandoned handles.
 
     A caller that polls a handle and never synchronizes it would otherwise
     grow this map (and the collective table) forever; past the cap, the
     oldest done-but-unconsumed handles are released."""
     with _handle_meta_lock:
-        _handle_meta[h] = dtype
+        _handle_meta[h] = (dtype, target)
         if len(_handle_meta) > _HANDLE_META_CAP:
             for old in list(_handle_meta):   # insertion order = oldest first
                 if old == h or len(_handle_meta) <= _HANDLE_META_CAP // 2:
@@ -144,6 +268,28 @@ def allreduce_async(tensor, average=None, name: Optional[str] = None,
     return _remember_handle(h, tensor.dtype)
 
 
+def allreduce_async_(tensor, average=None, name: Optional[str] = None,
+                     op=None, prescale_factor: float = 1.0,
+                     postscale_factor: float = 1.0) -> int:
+    """Async in-place allreduce: ``synchronize(handle)`` writes the
+    reduced value into ``tensor`` and returns it (reference:
+    torch/mpi_ops.py allreduce_async_). Do not mutate ``tensor`` between
+    submission and synchronize — the staging may read it lazily."""
+    h = _c.allreduce_async(_to_numpy(tensor), average=average, name=name,
+                           op=op, prescale_factor=prescale_factor,
+                           postscale_factor=postscale_factor)
+    return _remember_handle(h, tensor.dtype, target=tensor)
+
+
+def broadcast_async_(tensor, root_rank: int,
+                     name: Optional[str] = None) -> int:
+    """Async in-place broadcast (reference: torch/mpi_ops.py
+    broadcast_async_)."""
+    h = _c.broadcast_async(_to_numpy(tensor), root_rank=root_rank,
+                           name=name)
+    return _remember_handle(h, tensor.dtype, target=tensor)
+
+
 def allgather_async(tensor, name: Optional[str] = None) -> int:
     h = _c.allgather_async(_to_numpy(tensor), name=name)
     return _remember_handle(h, tensor.dtype)
@@ -161,11 +307,22 @@ def alltoall_async(tensor, splits=None, name: Optional[str] = None) -> int:
 
 def synchronize(handle: int):
     """Wait for an async op; returns the result as a torch tensor when the
-    handle was created through this module, else the raw array."""
+    handle was created through this module, else the raw array. Handles
+    from the in-place ``*_`` variants copy the result into the original
+    tensor and return it (reference HandleManager in-place semantics)."""
     with _handle_meta_lock:
-        dtype = _handle_meta.pop(handle, None)
+        meta = _handle_meta.pop(handle, None)
     out = _c.synchronize(handle)
-    return _from_numpy(out, dtype) if dtype is not None else out
+    if meta is None:
+        return out
+    dtype, target = meta
+    result = _from_numpy(out, dtype)
+    if target is not None:
+        import torch
+        with torch.no_grad():
+            target.copy_(result)
+        return target
+    return result
 
 
 _synchronize_handle = _c.synchronize

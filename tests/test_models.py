@@ -120,3 +120,42 @@ def test_benchmark_scanned_stage(hvd_world):
     for r, _ in results:
         assert r.images_per_sec_per_chip > 0
         assert r.batch_per_chip == 2
+
+
+def test_space_to_depth_stem_matches_conv_stem():
+    """The space_to_depth stem must be EXACTLY the 7x7/s2 conv stem's
+    math (zero-padded kernel regrouping) — same params, same outputs.
+    fp32 end to end so the comparison is tight."""
+    import jax
+    import jax.numpy as jnp
+    from horovod_tpu.models import ResNet18
+
+    rng = jax.random.PRNGKey(42)
+    x = jax.random.normal(rng, (2, 32, 32, 3), jnp.float32)
+
+    a = ResNet18(num_classes=10, dtype=jnp.float32, stem="conv")
+    b = ResNet18(num_classes=10, dtype=jnp.float32, stem="space_to_depth")
+    va = a.init(jax.random.PRNGKey(7), x, train=False)
+    vb = b.init(jax.random.PRNGKey(7), x, train=False)
+    # identical param trees (same names, shapes, init streams)
+    ja = jax.tree_util.tree_structure(va)
+    jb = jax.tree_util.tree_structure(vb)
+    assert ja == jb
+    for la, lb in zip(jax.tree_util.tree_leaves(va),
+                      jax.tree_util.tree_leaves(vb)):
+        np.testing.assert_array_equal(np.asarray(la), np.asarray(lb))
+
+    ya = a.apply(va, x, train=False)
+    yb = b.apply(vb, x, train=False)
+    np.testing.assert_allclose(np.asarray(ya), np.asarray(yb),
+                               rtol=1e-5, atol=1e-5)
+
+    # gradients agree too (the training path)
+    def loss(m, v):
+        return jnp.sum(m.apply(v, x, train=False) ** 2)
+    ga = jax.grad(lambda v: loss(a, v))(va)
+    gb = jax.grad(lambda v: loss(b, v))(vb)
+    for la, lb in zip(jax.tree_util.tree_leaves(ga),
+                      jax.tree_util.tree_leaves(gb)):
+        np.testing.assert_allclose(np.asarray(la), np.asarray(lb),
+                                   rtol=2e-4, atol=2e-4)

@@ -102,6 +102,21 @@ def test_mismatched_metadata_raises_on_every_rank(mode):
         assert "CAUGHT TensorValidationError" in out, (mode, r, out[-500:])
 
 
+TORCH_GRAD_WORKER = os.path.join(os.path.dirname(__file__),
+                                 "torch_grad_worker.py")
+
+
+@pytest.mark.integration
+def test_torch_differentiable_collectives_2proc():
+    """Reference gradient semantics for allreduce/allgather/broadcast
+    across 2 processes (test_torch.py gradient tests; autograd Functions
+    of torch/mpi_ops.py), plus the in-place variants."""
+    codes, outs = _launch(2, script=TORCH_GRAD_WORKER)
+    for i, (c, o) in enumerate(zip(codes, outs)):
+        assert c == 0, f"worker {i} failed:\n{o[-4000:]}"
+        assert f"torch grad worker {i} OK" in o
+
+
 JOIN_VIOLATION_WORKER = os.path.join(os.path.dirname(__file__),
                                      "join_violation_worker.py")
 

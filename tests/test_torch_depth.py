@@ -313,3 +313,50 @@ def test_adasum_delta_optimizer_single_process_passthrough(hvd_world):
     (p.sum()).backward()
     opt.step()
     np.testing.assert_allclose(p.detach().numpy(), -0.1, rtol=1e-6)
+
+
+def test_inplace_collectives_single_process(hvd_world):
+    """allreduce_ / broadcast_ write the result into the input tensor and
+    return it (reference: torch/mpi_ops.py:225-253, 440-462)."""
+    import horovod_tpu.torch as hvd_t
+
+    t = torch.arange(6, dtype=torch.float32).reshape(2, 3)
+    out = hvd_t.allreduce_(t, op=hvd_t.Sum)
+    assert out is t
+    np.testing.assert_allclose(
+        t.numpy(), np.arange(6, dtype=np.float32).reshape(2, 3))
+
+    b = torch.full((3,), 5.0)
+    out = hvd_t.broadcast_(b, root_rank=0)
+    assert out is b
+    np.testing.assert_allclose(b.numpy(), 5.0)
+
+    # async in-place: handle synchronize returns the SAME tensor object
+    t2 = torch.ones(4)
+    h = hvd_t.allreduce_async_(t2, op=hvd_t.Sum, name="inplace_async")
+    got = hvd_t.synchronize(h)
+    assert got is t2
+    np.testing.assert_allclose(t2.numpy(), 1.0)
+
+
+def test_differentiable_collectives_single_process(hvd_world):
+    """Gradients flow through allreduce/allgather/broadcast (reference
+    autograd Functions, torch/mpi_ops.py:144-157, 290-308, 375-389).
+    With one process the ops are identities, so gradients must be exact."""
+    import horovod_tpu.torch as hvd_t
+
+    x = torch.arange(4, dtype=torch.float32, requires_grad=True)
+    y = hvd_t.allreduce(x, op=hvd_t.Sum)
+    assert y.requires_grad
+    (y * torch.tensor([1.0, 2.0, 3.0, 4.0])).sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [1, 2, 3, 4])
+
+    x2 = torch.ones(3, 2, requires_grad=True)
+    g = hvd_t.allgather(x2)
+    g.sum().backward()
+    np.testing.assert_allclose(x2.grad.numpy(), np.ones((3, 2)))
+
+    x3 = torch.ones(2, requires_grad=True)
+    b = hvd_t.broadcast(x3, root_rank=0)
+    (b * 3.0).sum().backward()
+    np.testing.assert_allclose(x3.grad.numpy(), [3.0, 3.0])

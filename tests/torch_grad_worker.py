@@ -1,0 +1,77 @@
+"""2-process check of differentiable torch collectives.
+
+Reference semantics being validated (test_torch.py gradient tests,
+autograd Functions in torch/mpi_ops.py):
+
+- allreduce: backward is the SAME allreduce of the upstream gradient —
+  with op=Average and rank-dependent upstream grads w_r, dL/dx_r is the
+  mean over ranks of w_r on every rank.
+- allgather: backward is a sum-allreduce of the upstream gradient,
+  narrowed to this rank's rows — rank-dependent row counts included.
+- broadcast: backward is a sum-allreduce delivered to the root, zero on
+  other ranks.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.pop("XLA_FLAGS", None)
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+import torch  # noqa: E402
+
+import horovod_tpu.torch as hvd  # noqa: E402
+
+
+def main():
+    hvd.init()
+    r, n = hvd.rank(), hvd.size()
+    assert n == 2
+
+    # -- allreduce(Average): dL/dx_r = mean_r(w_r) ---------------------------
+    x = torch.ones(4, requires_grad=True)
+    y = hvd.allreduce(x, op=hvd.Average, name="g_ar")
+    w = float(r + 1)                      # rank-dependent upstream grad
+    (y * w).sum().backward()
+    expected = np.full(4, (1.0 + 2.0) / 2)
+    np.testing.assert_allclose(x.grad.numpy(), expected, rtol=1e-6)
+
+    # -- allgather with ragged rows: grad = n * upstream rows of this rank --
+    rows = r + 1
+    xg = torch.ones(rows, 3, requires_grad=True)
+    g = hvd.allgather(xg, name="g_ag")
+    assert g.shape == (3, 3)              # 1 + 2 rows
+    # upstream grad = global row index, identical on every rank
+    up = torch.arange(3, dtype=torch.float32)[:, None].expand(3, 3)
+    (g * up).sum().backward()
+    offset = 0 if r == 0 else 1
+    expected = n * np.arange(3, dtype=np.float32)[offset:offset + rows,
+                                                  None] * np.ones((rows, 3))
+    np.testing.assert_allclose(xg.grad.numpy(), expected, rtol=1e-6)
+
+    # -- broadcast: grad lands summed on root, zero elsewhere ----------------
+    xb = torch.ones(2, requires_grad=True)
+    b = hvd.broadcast(xb, root_rank=0, name="g_bc")
+    (b * float(r + 1)).sum().backward()
+    expected = np.full(2, 3.0) if r == 0 else np.zeros(2)
+    np.testing.assert_allclose(xb.grad.numpy(), expected, rtol=1e-6)
+
+    # -- in-place variants agree across ranks --------------------------------
+    t = torch.full((3,), float(r + 1))
+    hvd.allreduce_(t, op=hvd.Sum, name="g_arin")
+    np.testing.assert_allclose(t.numpy(), 3.0)
+
+    tb = torch.full((2,), float(r * 10))
+    hvd.broadcast_(tb, root_rank=1, name="g_bcin")
+    np.testing.assert_allclose(tb.numpy(), 10.0)
+
+    print(f"torch grad worker {r} OK", flush=True)
+    hvd.shutdown()
+
+
+if __name__ == "__main__":
+    main()
