@@ -98,3 +98,38 @@ def allgather_object(obj: Any, name: Optional[str] = None,
         out.append(pickle.loads(chunk.tobytes()))
         off += int(s)
     return out
+
+
+# -- shared backward math for differentiable collectives ---------------------
+# The torch autograd Functions and the TF custom_gradient closures both
+# implement the reference's collective gradients at the numpy boundary;
+# these helpers are the single copy of that algorithm
+# (reference: RegisterGradient entries in tensorflow/mpi_ops.cc and the
+# autograd Functions in torch/mpi_ops.py).
+
+def allgather_grad_numpy(grad_np: np.ndarray, dim0: int,
+                         was_scalar: bool = False) -> np.ndarray:
+    """Gradient of allgather: sum-allreduce the upstream gradient and
+    narrow to this process's rows (ragged row counts handled by an
+    allgather of per-rank dim0s)."""
+    reduced = np.asarray(_c.allreduce(grad_np, op=_c.Sum))
+    if reduced.ndim == 0:
+        # size-1 world gathering a scalar: the gathered result (and so
+        # its gradient) is itself 0-d
+        return reduced
+    dims = np.asarray(_c.allgather(
+        np.array([dim0], np.int64))).reshape(-1)
+    offset = int(dims[:_basics.rank()].sum())
+    piece = reduced[offset:offset + dim0]
+    if was_scalar:
+        piece = piece.reshape(())
+    return piece
+
+
+def broadcast_grad_numpy(grad_np: np.ndarray, root_rank: int) -> np.ndarray:
+    """Gradient of broadcast: sum-allreduce delivered to the root, zero
+    on every other process."""
+    reduced = np.asarray(_c.allreduce(grad_np, op=_c.Sum))
+    if _basics.rank() != root_rank:
+        reduced = np.zeros_like(reduced)
+    return reduced

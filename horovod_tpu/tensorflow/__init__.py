@@ -30,6 +30,7 @@ from typing import Any, List, Optional
 
 import numpy as np
 
+from .. import basics as _basics
 from .. import collectives as _c
 from ..basics import (  # noqa: F401  (reference API parity re-exports)
     init, shutdown, is_initialized, rank, size, local_rank, local_size,
@@ -88,20 +89,72 @@ def allreduce(tensor, average=None, name: Optional[str] = None, op=None,
             values=_from_result(np.asarray(out.values)),
             indices=_from_result(np.asarray(out.indices)),
             dense_shape=tensor.dense_shape)
-    out = _c.allreduce(_to_numpy(tensor), average=average, name=name, op=op,
-                       prescale_factor=prescale_factor,
-                       postscale_factor=postscale_factor)
-    return _from_result(out, tensor.dtype)
+    # Differentiable (reference: RegisterGradient("HorovodAllreduce"),
+    # tensorflow/mpi_ops.py — the gradient of an allreduce is the same
+    # allreduce of the upstream gradient). tf.custom_gradient records the
+    # grad fn on the tape in eager mode; inside tf.function use the
+    # DistributedGradientTape / optimizer wrappers, which route through a
+    # py_function submission point instead.
+    op_r = _c._resolve_op(average, op)
+
+    @tf.custom_gradient
+    def _differentiable(x):
+        out = _from_result(
+            _c.allreduce(_to_numpy(x), op=op_r, name=name,
+                         prescale_factor=prescale_factor,
+                         postscale_factor=postscale_factor), x.dtype)
+
+        def grad(dy):
+            return _from_result(
+                _c.allreduce(_to_numpy(dy), op=op_r,
+                             prescale_factor=prescale_factor,
+                             postscale_factor=postscale_factor), dy.dtype)
+        return out, grad
+    return _differentiable(tensor)
 
 
 def allgather(tensor, name: Optional[str] = None):
-    out = _c.allgather(_to_numpy(tensor), name=name)
-    return _from_result(out, tensor.dtype)
+    """Differentiable allgather (reference gradient: sum-allreduce of the
+    upstream gradient, narrowed to this process's rows —
+    RegisterGradient("HorovodAllgather"), tensorflow/mpi_ops.py). The
+    backward math is shared with the torch bridge
+    (functions.allgather_grad_numpy)."""
+    tf = _tf()
+    from ..functions import allgather_grad_numpy
+    nd = np.ndim(tensor) if isinstance(tensor, np.ndarray) \
+        else tensor.shape.rank
+    dim0 = int(tensor.shape[0]) if nd else 1
+
+    @tf.custom_gradient
+    def _differentiable(x):
+        out = _from_result(_c.allgather(_to_numpy(x), name=name), x.dtype)
+
+        def grad(dy):
+            return _from_result(
+                allgather_grad_numpy(_to_numpy(dy), dim0,
+                                     was_scalar=nd == 0), dy.dtype)
+        return out, grad
+    return _differentiable(tensor)
 
 
 def broadcast(tensor, root_rank: int, name: Optional[str] = None):
-    out = _c.broadcast(_to_numpy(tensor), root_rank=root_rank, name=name)
-    return _from_result(out, tensor.dtype)
+    """Differentiable broadcast (reference gradient: sum-allreduce
+    delivered to the root, zero elsewhere —
+    RegisterGradient("HorovodBroadcast"), tensorflow/mpi_ops.py)."""
+    tf = _tf()
+    from ..functions import broadcast_grad_numpy
+
+    @tf.custom_gradient
+    def _differentiable(x):
+        out = _from_result(
+            _c.broadcast(_to_numpy(x), root_rank=root_rank, name=name),
+            x.dtype)
+
+        def grad(dy):
+            return _from_result(
+                broadcast_grad_numpy(_to_numpy(dy), root_rank), dy.dtype)
+        return out, grad
+    return _differentiable(tensor)
 
 
 def alltoall(tensor, splits=None, name: Optional[str] = None):

@@ -117,6 +117,8 @@ def _autograd_fns():
                                 grad.dtype),
                     None, None, None, None, None)
 
+    from ..functions import allgather_grad_numpy, broadcast_grad_numpy
+
     class _AllgatherFn(torch.autograd.Function):
         @staticmethod
         def forward(ctx, tensor, name):
@@ -127,19 +129,8 @@ def _autograd_fns():
 
         @staticmethod
         def backward(ctx, grad):
-            reduced = np.asarray(
-                _c.allreduce(_to_numpy(grad), op=_c.Sum))
-            dims = np.asarray(_c.allgather(
-                np.array([ctx.dim0], np.int64))).reshape(-1)
-            offset = int(dims[:_basics.rank()].sum())
-            if reduced.ndim == 0:
-                # size-1 world gathering a scalar: the gathered result
-                # (and so its gradient) is itself 0-d
-                piece = reduced
-            else:
-                piece = reduced[offset:offset + ctx.dim0]
-                if ctx.was_scalar:
-                    piece = piece.reshape(())
+            piece = allgather_grad_numpy(_to_numpy(grad), ctx.dim0,
+                                         ctx.was_scalar)
             return _from_numpy(piece, grad.dtype), None
 
     class _BroadcastFn(torch.autograd.Function):
@@ -152,11 +143,9 @@ def _autograd_fns():
 
         @staticmethod
         def backward(ctx, grad):
-            reduced = _from_numpy(
-                _c.allreduce(_to_numpy(grad), op=_c.Sum), grad.dtype)
-            if _basics.rank() != ctx.root_rank:
-                reduced = reduced * 0
-            return reduced, None, None
+            return (_from_numpy(
+                broadcast_grad_numpy(_to_numpy(grad), ctx.root_rank),
+                grad.dtype), None, None)
 
     fns = {"allreduce": _AllreduceFn, "allgather": _AllgatherFn,
            "broadcast": _BroadcastFn}
@@ -237,9 +226,14 @@ def _remember_handle(h: int, dtype, target=None) -> int:
 
     A caller that polls a handle and never synchronizes it would otherwise
     grow this map (and the collective table) forever; past the cap, the
-    oldest done-but-unconsumed handles are released."""
+    oldest done-but-unconsumed handles are released. The in-place target
+    is held by WEAK reference so an abandoned handle never pins the
+    tensor's memory (the no-leak guarantee covers the payload too)."""
+    import weakref
     with _handle_meta_lock:
-        _handle_meta[h] = (dtype, target)
+        _handle_meta[h] = (dtype,
+                           weakref.ref(target) if target is not None
+                           else None)
         if len(_handle_meta) > _HANDLE_META_CAP:
             for old in list(_handle_meta):   # insertion order = oldest first
                 if old == h or len(_handle_meta) <= _HANDLE_META_CAP // 2:
@@ -315,8 +309,9 @@ def synchronize(handle: int):
     out = _c.synchronize(handle)
     if meta is None:
         return out
-    dtype, target = meta
+    dtype, target_ref = meta
     result = _from_numpy(out, dtype)
+    target = target_ref() if target_ref is not None else None
     if target is not None:
         import torch
         with torch.no_grad():

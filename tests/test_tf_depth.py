@@ -212,3 +212,33 @@ def test_alltoall_async_is_actually_async(hvd_world):
         release.set()
     out = _c.synchronize(h)
     np.testing.assert_allclose(np.asarray(out), np.arange(4))
+
+
+def test_tf_differentiable_collectives(hvd_world):
+    """Gradients flow through hvd.allreduce/allgather/broadcast on the
+    tape (reference: RegisterGradient entries in tensorflow/mpi_ops.py).
+    One process => the ops are identities, gradients must be exact."""
+    import numpy as np
+    import tensorflow as tf
+    import horovod_tpu.tensorflow as hvd_tf
+
+    x = tf.Variable([1.0, 2.0, 3.0])
+    with tf.GradientTape() as tape:
+        y = hvd_tf.allreduce(x, op=hvd_tf.Sum)
+        loss = tf.reduce_sum(y * tf.constant([1.0, 2.0, 3.0]))
+    g = tape.gradient(loss, x)
+    np.testing.assert_allclose(g.numpy(), [1.0, 2.0, 3.0])
+
+    v = tf.Variable(np.ones((3, 2), np.float32))
+    with tf.GradientTape() as tape:
+        y = hvd_tf.allgather(v)
+        loss = tf.reduce_sum(y)
+    g = tape.gradient(loss, v)
+    np.testing.assert_allclose(g.numpy(), np.ones((3, 2)))
+
+    b = tf.Variable([5.0, 6.0])
+    with tf.GradientTape() as tape:
+        y = hvd_tf.broadcast(b, root_rank=0)
+        loss = tf.reduce_sum(y * 2.0)
+    g = tape.gradient(loss, b)
+    np.testing.assert_allclose(g.numpy(), [2.0, 2.0])
