@@ -73,10 +73,21 @@ def _from_result(out, dtype=None):
 
 
 def allreduce(tensor, average=None, name: Optional[str] = None, op=None,
-              prescale_factor: float = 1.0, postscale_factor: float = 1.0):
+              prescale_factor: float = 1.0, postscale_factor: float = 1.0,
+              compression=None, device_dense: str = "",
+              device_sparse: str = ""):
     """Allreduce of a tf.Tensor (reference: tensorflow/__init__.py:52-131).
-    tf.IndexedSlices take the gather path (reference :87-102)."""
+    tf.IndexedSlices take the gather path (reference :87-102).
+    ``compression`` compresses the wire payload (numpy boundary, applied
+    inside the gradient-recording closure so gradients still flow).
+    ``device_dense``/``device_sparse`` are accepted for reference API
+    parity and ignored: data-plane placement belongs to XLA here, not to
+    tf.device scopes."""
     tf = _tf()
+    del device_dense, device_sparse
+    if compression is None:
+        from ..compression import Compression
+        compression = Compression.none
     if isinstance(tensor, tf.IndexedSlices):
         from ..sparse import SparseGradient, allreduce_sparse
         avg = op is None and (average is None or average) or op == Average
@@ -99,16 +110,18 @@ def allreduce(tensor, average=None, name: Optional[str] = None, op=None,
 
     @tf.custom_gradient
     def _differentiable(x):
-        out = _from_result(
-            _c.allreduce(_to_numpy(x), op=op_r, name=name,
-                         prescale_factor=prescale_factor,
-                         postscale_factor=postscale_factor), x.dtype)
+        payload, cc = compression.compress(_to_numpy(x))
+        out = _c.allreduce(payload, op=op_r, name=name,
+                           prescale_factor=prescale_factor,
+                           postscale_factor=postscale_factor)
+        out = _from_result(compression.decompress(out, cc), x.dtype)
 
         def grad(dy):
-            return _from_result(
-                _c.allreduce(_to_numpy(dy), op=op_r,
+            gp, gcc = compression.compress(_to_numpy(dy))
+            g = _c.allreduce(gp, op=op_r,
                              prescale_factor=prescale_factor,
-                             postscale_factor=postscale_factor), dy.dtype)
+                             postscale_factor=postscale_factor)
+            return _from_result(compression.decompress(g, gcc), dy.dtype)
         return out, grad
     return _differentiable(tensor)
 
@@ -198,7 +211,6 @@ def broadcast_variables(variables: List, root_rank: int = 0) -> None:
     Fused: variables are bucketed to the fusion threshold and each bucket
     rides ONE grouped broadcast dispatch — not one collective per variable
     (reference fusion-buffer broadcasts, collective_operations.cc:37-81)."""
-    from .. import basics as _basics
     from .. import config as _config
     from ..fusion import plan_buckets
     vars_ = list(variables)

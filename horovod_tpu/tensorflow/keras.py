@@ -1,0 +1,14 @@
+"""``horovod_tpu.tensorflow.keras`` — the reference's primary TF2 Keras
+entry point (``import horovod.tensorflow.keras as hvd``; reference
+``horovod/tensorflow/keras/__init__.py`` wraps the same shared ``_keras``
+implementation as ``horovod.keras``). Identical surface to
+:mod:`horovod_tpu.keras`; both route through the TF bridge.
+"""
+
+from ..keras import (  # noqa: F401
+    init, shutdown, is_initialized, rank, size, local_rank, local_size,
+    Average, Sum, Adasum,
+    DistributedOptimizer, allreduce, allgather, broadcast,
+    broadcast_variables, callbacks,
+)
+from . import elastic  # noqa: F401  (KerasState + elastic callbacks)

@@ -227,13 +227,14 @@ def _remember_handle(h: int, dtype, target=None) -> int:
     A caller that polls a handle and never synchronizes it would otherwise
     grow this map (and the collective table) forever; past the cap, the
     oldest done-but-unconsumed handles are released. The in-place target
-    is held by WEAK reference so an abandoned handle never pins the
-    tensor's memory (the no-leak guarantee covers the payload too)."""
-    import weakref
+    is held STRONGLY until synchronize/eviction — callers routinely pass
+    temporary wrappers over live storage (``p.grad.data``), and a weak
+    reference would silently drop the in-place write when the wrapper is
+    collected (the reference's HandleManager likewise holds the output
+    tensor until synchronize). The cost: an abandoned in-place handle
+    pins its tensor until evicted."""
     with _handle_meta_lock:
-        _handle_meta[h] = (dtype,
-                           weakref.ref(target) if target is not None
-                           else None)
+        _handle_meta[h] = (dtype, target)
         if len(_handle_meta) > _HANDLE_META_CAP:
             for old in list(_handle_meta):   # insertion order = oldest first
                 if old == h or len(_handle_meta) <= _HANDLE_META_CAP // 2:
@@ -309,9 +310,8 @@ def synchronize(handle: int):
     out = _c.synchronize(handle)
     if meta is None:
         return out
-    dtype, target_ref = meta
+    dtype, target = meta
     result = _from_numpy(out, dtype)
-    target = target_ref() if target_ref is not None else None
     if target is not None:
         import torch
         with torch.no_grad():

@@ -88,3 +88,18 @@ class TestKeras:
         np.testing.assert_allclose(
             float(np.asarray(model.optimizer.learning_rate)),
             0.1 * 0.5 ** 2, rtol=1e-5)
+
+
+def test_tensorflow_keras_namespace_parity(hvd_world):
+    """The reference's primary TF2 entry point spelling
+    (`import horovod.tensorflow.keras as hvd`) resolves here too and
+    carries the full Keras surface."""
+    import horovod_tpu.keras as hk
+    import horovod_tpu.tensorflow.keras as htk
+
+    assert htk.DistributedOptimizer is hk.DistributedOptimizer
+    assert htk.callbacks is hk.callbacks
+    assert htk.elastic.KerasState.__name__ == "TensorFlowKerasState"
+    for name in ("init", "rank", "size", "allreduce", "broadcast_variables",
+                 "Average", "Sum", "Adasum"):
+        assert hasattr(htk, name), name

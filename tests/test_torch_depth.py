@@ -360,3 +360,19 @@ def test_differentiable_collectives_single_process(hvd_world):
     b = hvd_t.broadcast(x3, root_rank=0)
     (b * 3.0).sum().backward()
     np.testing.assert_allclose(x3.grad.numpy(), [3.0, 3.0])
+
+
+def test_inplace_async_through_temporary_wrapper(hvd_world):
+    """allreduce_async_(p.grad.data): the caller's wrapper tensor is a
+    temporary over live storage — the in-place write must still land in
+    that storage (the reason the handle holds its target strongly)."""
+    import gc
+    import horovod_tpu.torch as hvd_t
+
+    p = torch.nn.Parameter(torch.zeros(4))
+    p.grad = torch.full((4,), 2.0)
+    h = hvd_t.allreduce_async_(p.grad.data, op=hvd_t.Sum,
+                               prescale_factor=10.0, postscale_factor=1.0)
+    gc.collect()   # drop the temporary wrapper; storage stays live
+    hvd_t.synchronize(h)
+    np.testing.assert_allclose(p.grad.numpy(), 20.0)
