@@ -30,3 +30,28 @@ from ..tensorflow import (  # noqa: F401
 )
 
 from . import callbacks  # noqa: F401  (module at the end: imports keras)
+
+
+def load_model(filepath, custom_optimizers=None, custom_objects=None,
+               compression=None):
+    """Load a saved Keras model with its optimizer wrapped in
+    ``DistributedOptimizer`` so retraining reduces gradients (reference:
+    horovod/keras/__init__.py:117-145 load_model).
+
+    The reference wraps optimizer CLASSES during deserialization (its
+    wrapper is a dynamic subclass that must round-trip through Keras's
+    object registry); this bridge's wrapper patches ``apply_gradients``
+    on the live optimizer INSTANCE, so the model loads normally —
+    optimizer state (slots, iterations) included — and the deserialized
+    optimizer is wrapped afterwards. ``custom_optimizers`` therefore
+    only needs to make the classes visible to deserialization; wrapping
+    is unconditional.
+    """
+    import keras
+    objects = dict(custom_objects or {})
+    for cls in custom_optimizers or ():
+        objects.setdefault(cls.__name__, cls)
+    model = keras.models.load_model(filepath, custom_objects=objects)
+    if getattr(model, "optimizer", None) is not None:
+        DistributedOptimizer(model.optimizer, compression=compression)
+    return model

@@ -72,9 +72,16 @@ def _from_result(out, dtype=None):
     return t
 
 
+def _resolve_compression(compression):
+    if compression is None:
+        from ..compression import Compression
+        return Compression.none
+    return compression
+
+
 def allreduce(tensor, average=None, name: Optional[str] = None, op=None,
               prescale_factor: float = 1.0, postscale_factor: float = 1.0,
-              compression=None, device_dense: str = "",
+              *, compression=None, device_dense: str = "",
               device_sparse: str = ""):
     """Allreduce of a tf.Tensor (reference: tensorflow/__init__.py:52-131).
     tf.IndexedSlices take the gather path (reference :87-102).
@@ -82,12 +89,13 @@ def allreduce(tensor, average=None, name: Optional[str] = None, op=None,
     inside the gradient-recording closure so gradients still flow).
     ``device_dense``/``device_sparse`` are accepted for reference API
     parity and ignored: data-plane placement belongs to XLA here, not to
-    tf.device scopes."""
+    tf.device scopes. These parity params are KEYWORD-ONLY — the
+    positional tail of the reference signature differs (it has no
+    ``name``), so a positional reference-style call raises instead of
+    silently misbinding."""
     tf = _tf()
     del device_dense, device_sparse
-    if compression is None:
-        from ..compression import Compression
-        compression = Compression.none
+    compression = _resolve_compression(compression)
     if isinstance(tensor, tf.IndexedSlices):
         from ..sparse import SparseGradient, allreduce_sparse
         avg = op is None and (average is None or average) or op == Average
@@ -239,7 +247,8 @@ def broadcast_object(obj: Any, root_rank: int = 0,
 
 
 def _reduce_gradients(grads, op, name_prefix: str,
-                      prescale: float = 1.0, postscale: float = 1.0):
+                      prescale: float = 1.0, postscale: float = 1.0,
+                      compression=None):
     """Reduce a list of TF gradients (None entries pass through).
 
     Eager tensors reduce directly. Inside a tf.function (Keras 3 traces
@@ -248,9 +257,11 @@ def _reduce_gradients(grads, op, name_prefix: str,
     submission point, so every process issues the identical collective
     sequence regardless of TF's graph scheduling (the ordering guarantee
     the reference gets from its background negotiation thread), and the
-    gradients fuse like the reference's fusion buffer.
+    gradients fuse like the reference's fusion buffer. ``compression``
+    compresses the wire payloads (numpy boundary).
     """
     tf = _tf()
+    compression = _resolve_compression(compression)
     present = [(i, g) for i, g in enumerate(grads) if g is not None]
     if not present:
         return list(grads)
@@ -260,11 +271,13 @@ def _reduce_gradients(grads, op, name_prefix: str,
         for i, g in present]
 
     def _eager_reduce(*tensors):
+        pairs = [compression.compress(np.asarray(t)) for t in tensors]
         outs = _c.grouped_allreduce(
-            [np.asarray(t) for t in tensors], op=op,
+            [c for c, _ in pairs], op=op,
             name=name_prefix + ".grads",
             prescale_factor=prescale, postscale_factor=postscale)
-        return [np.asarray(o) for o in outs]
+        return [np.asarray(compression.decompress(o, cc))
+                for o, (_, cc) in zip(outs, pairs)]
 
     symbolic = any(not hasattr(g, "numpy") for _, g in dense)
     tensors = [g for _, g in dense]
@@ -292,27 +305,31 @@ class DistributedGradientTape:
                  postscale_factor: float = 1.0):
         self._tape = tape
         self._op = op
+        self._compression = compression
         self._prescale = prescale_factor
         self._postscale = postscale_factor
 
     def gradient(self, target, sources, output_gradients=None):
         grads = self._tape.gradient(target, sources, output_gradients)
         return _reduce_gradients(grads, self._op, "tape",
-                                 self._prescale, self._postscale)
+                                 self._prescale, self._postscale,
+                                 compression=self._compression)
 
     def __getattr__(self, item):
         return getattr(self._tape, item)
 
 
-def DistributedOptimizer(optimizer, op=Average, name_prefix: str = "opt"):
+def DistributedOptimizer(optimizer, op=Average, name_prefix: str = "opt",
+                         compression=None):
     """Wrap a keras/TF optimizer so ``apply_gradients`` reduces gradients
     first (reference: tensorflow/__init__.py:259-301 _DistributedOptimizer
     compute_gradients override; with Keras 3 the interception point is
-    apply_gradients)."""
+    apply_gradients). ``compression`` compresses the wire payloads."""
 
     def apply_gradients(grads_and_vars, *args, **kwargs):
         gv = list(grads_and_vars)
-        reduced = _reduce_gradients([g for g, _ in gv], op, name_prefix)
+        reduced = _reduce_gradients([g for g, _ in gv], op, name_prefix,
+                                    compression=compression)
         return type(optimizer).apply_gradients(
             optimizer, [(r, v) for r, (_, v) in zip(reduced, gv)],
             *args, **kwargs)

@@ -9,6 +9,6 @@ from ..keras import (  # noqa: F401
     init, shutdown, is_initialized, rank, size, local_rank, local_size,
     Average, Sum, Adasum,
     DistributedOptimizer, allreduce, allgather, broadcast,
-    broadcast_variables, callbacks,
+    broadcast_variables, callbacks, load_model,
 )
 from . import elastic  # noqa: F401  (KerasState + elastic callbacks)

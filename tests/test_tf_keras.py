@@ -103,3 +103,39 @@ def test_tensorflow_keras_namespace_parity(hvd_world):
     for name in ("init", "rank", "size", "allreduce", "broadcast_variables",
                  "Average", "Sum", "Adasum"):
         assert hasattr(htk, name), name
+
+
+def test_keras_load_model_wraps_optimizer(hvd_world, tmp_path, monkeypatch):
+    """load_model returns a model whose deserialized optimizer reduces
+    gradients through the collective plane (reference:
+    horovod/keras/__init__.py load_model)."""
+    import numpy as np
+    import tensorflow as tf
+    import horovod_tpu.keras as hvd_k
+    import horovod_tpu.tensorflow as hvd_tf
+
+    model = tf.keras.Sequential(
+        [tf.keras.layers.Dense(2, input_shape=(3,))])
+    model.compile(optimizer=tf.keras.optimizers.SGD(0.1), loss="mse")
+    x = np.ones((4, 3), np.float32)
+    y = np.zeros((4, 2), np.float32)
+    model.fit(x, y, epochs=1, verbose=0)
+    path = str(tmp_path / "model.keras")
+    model.save(path)
+
+    loaded = hvd_k.load_model(path)
+    # optimizer state round-tripped and apply_gradients is OUR wrapper
+    assert loaded.optimizer is not None
+    assert loaded.optimizer.apply_gradients.__qualname__.startswith(
+        "DistributedOptimizer")
+    # training after load really routes through the collective plane
+    calls = {"grouped": 0}
+    real = hvd_tf._c.grouped_allreduce
+
+    def spy(*a, **kw):
+        calls["grouped"] += 1
+        return real(*a, **kw)
+
+    monkeypatch.setattr(hvd_tf._c, "grouped_allreduce", spy)
+    loaded.fit(x, y, epochs=1, verbose=0)
+    assert calls["grouped"] >= 1, "loaded optimizer bypassed the reduction"
