@@ -636,7 +636,7 @@ def allgather_async(tensor, name: Optional[str] = None, process_set=None) -> int
     tl = w.timeline
     tl.start(name, "allgather")
     wm = process_set or w.world_mesh
-    local = np.asarray(tensor)
+    local = _stage_input(tensor)
     _record_round(w, ("allgather", name, tuple(local.shape),
                       str(local.dtype)))
 
@@ -669,9 +669,13 @@ def allgather_async(tensor, name: Optional[str] = None, process_set=None) -> int
                     shape, str(local.dtype)), build)
             result = _local_result(fn(garr))
         else:
-            # ragged: pad to max, gather, slice+concat with static sizes
+            # ragged: pad to max, gather, slice+concat with static sizes.
+            # jnp.pad keeps a device-resident jax input on device (np.pad
+            # would __array__-readback exactly the staging _stage_input
+            # avoids); numpy inputs land on device here either way.
             pad = maxd - dim0
-            padded = np.pad(local, [(0, pad)] + [(0, 0)] * (local.ndim - 1))
+            padded = jnp.pad(local,
+                             [(0, pad)] + [(0, 0)] * (local.ndim - 1))
             garr = _global_from_local(wm, padded)
             sizes_t = tuple(int(s) for s in sizes)
 
@@ -723,7 +727,7 @@ def broadcast_async(tensor, root_rank: int, name: Optional[str] = None,
     tl.start(name, "broadcast")
     wm = process_set or w.world_mesh
     nproc = wm.num_procs
-    local = np.asarray(tensor)
+    local = _stage_input(tensor)
     if not (0 <= root_rank < nproc):
         _finish(w, h)
         raise ValueError(f"root_rank {root_rank} out of range for world "

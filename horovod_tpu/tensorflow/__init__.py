@@ -79,9 +79,9 @@ def _resolve_compression(compression):
     return compression
 
 
-def allreduce(tensor, average=None, name: Optional[str] = None, op=None,
+def allreduce(tensor, average=None, *, name: Optional[str] = None, op=None,
               prescale_factor: float = 1.0, postscale_factor: float = 1.0,
-              *, compression=None, device_dense: str = "",
+              compression=None, device_dense: str = "",
               device_sparse: str = ""):
     """Allreduce of a tf.Tensor (reference: tensorflow/__init__.py:52-131).
     tf.IndexedSlices take the gather path (reference :87-102).
@@ -89,10 +89,11 @@ def allreduce(tensor, average=None, name: Optional[str] = None, op=None,
     inside the gradient-recording closure so gradients still flow).
     ``device_dense``/``device_sparse`` are accepted for reference API
     parity and ignored: data-plane placement belongs to XLA here, not to
-    tf.device scopes. These parity params are KEYWORD-ONLY — the
-    positional tail of the reference signature differs (it has no
-    ``name``), so a positional reference-style call raises instead of
-    silently misbinding."""
+    tf.device scopes. Everything past ``average`` is KEYWORD-ONLY — the
+    reference's positional tail differs (its third positional is
+    ``device_dense``, this plane has ``name``), so a positional
+    reference-style call raises at the call site instead of silently
+    misbinding a device string as a collective name."""
     tf = _tf()
     del device_dense, device_sparse
     compression = _resolve_compression(compression)

@@ -193,3 +193,24 @@ def test_injit_ppermute_ring(hvd_world, mesh8):
                   in_specs=P("world"), out_specs=P("world"))
     out = np.asarray(jax.jit(f)(x)).reshape(-1)
     np.testing.assert_allclose(out, np.roll(np.arange(8, dtype=np.float32), 1))
+
+
+def test_jax_array_inputs_stay_on_device(hvd_world):
+    """allreduce/allgather/broadcast accept jax arrays without a host
+    round trip (_stage_input keeps fully-addressable jax arrays as-is;
+    the r4 microbench exists to catch staging waste)."""
+    import jax.numpy as jnp
+    from horovod_tpu import collectives as _c
+
+    x = jnp.arange(8, dtype=jnp.float32)
+    np.testing.assert_allclose(
+        np.asarray(_c.allreduce(x, op=_c.Sum, name="jx.ar")),
+        np.arange(8, dtype=np.float32))
+    g = _c.allgather(jnp.ones((2, 3), jnp.float32), name="jx.ag")
+    assert np.asarray(g).shape == (2, 3)
+    b = _c.broadcast(jnp.full((4,), 7.0, jnp.float32), root_rank=0,
+                     name="jx.bc")
+    np.testing.assert_allclose(np.asarray(b), 7.0)
+    # bf16 path (no numpy-native dtype) survives too
+    hb = _c.allreduce(jnp.ones((3,), jnp.bfloat16), op=_c.Sum, name="jx.bf")
+    assert str(np.asarray(hb).dtype) == "bfloat16"
