@@ -233,6 +233,14 @@ def worker_main(cpu: bool, batch_override=None):
                  num_batches_per_iter=10, num_iters=10, scanned=True),
             dict(batch_per_chip=256, num_warmup_batches=5,
                  num_batches_per_iter=10, num_iters=10, scanned=True),
+            # Opportunistic: the math-equivalent space-to-depth stem
+            # (models/resnet.py SpaceToDepthStem) re-measured at the best
+            # batch. Usually skipped on a 420 s budget (stage margin);
+            # with a larger budget, best-line semantics keep whichever
+            # stem wins.
+            dict(batch_per_chip=256, num_warmup_batches=5,
+                 num_batches_per_iter=10, num_iters=10, scanned=True,
+                 stem="space_to_depth"),
         ]
 
     best_v = -1.0
@@ -247,7 +255,8 @@ def worker_main(cpu: bool, batch_override=None):
         # same-shape predecessor earns the small margin.
         same_rig = prev_ok and i > 0 and (
             stages[i]["batch_per_chip"] == stages[i - 1]["batch_per_chip"]
-            and stages[i].get("scanned") == stages[i - 1].get("scanned"))
+            and stages[i].get("scanned") == stages[i - 1].get("scanned")
+            and stages[i].get("stem") == stages[i - 1].get("stem"))
         margin = 30.0 if same_rig else STAGE_MARGIN_S
         if i > 0 and time.time() > deadline - margin:
             _log(f"worker: {deadline - time.time():.0f}s left < "

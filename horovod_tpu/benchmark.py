@@ -82,7 +82,8 @@ class _Rig:
     """
 
     def __init__(self, batch_per_chip: int, image_size: int,
-                 model_name: str, optimizer_name: str):
+                 model_name: str, optimizer_name: str,
+                 stem: Optional[str] = None):
         import jax
         import jax.numpy as jnp
         import optax
@@ -108,10 +109,11 @@ class _Rig:
         import os
         # Math-equivalent MXU-friendly stem (models/resnet.py
         # SpaceToDepthStem); numerics-tested equal, so using it is a
-        # layout optimization, not a model change.
-        stem = os.environ.get("HVD_TPU_BENCH_STEM", "conv")
+        # layout optimization, not a model change. Per-stage override >
+        # env knob > canonical conv.
+        self.stem = stem or os.environ.get("HVD_TPU_BENCH_STEM", "conv")
         model = {"resnet50": ResNet50, "resnet18": ResNet18}[model_name](
-            num_classes=1000, stem=stem)
+            num_classes=1000, stem=self.stem)
 
         rng = jax.random.PRNGKey(0)
         self.images = jax.device_put(
@@ -293,14 +295,23 @@ def synthetic_resnet50_ladder(stages, image_size: int = 224,
     The caller decides whether to pull the next stage — checking its
     remaining wall-clock budget before paying the next compile.
     """
+    import os
     rig = None
     for st in stages:
         b = st["batch_per_chip"]
+        # a stage without an explicit stem resolves to the env default —
+        # the SAME resolution _Rig applies — so a default stage after a
+        # stem-overridden one correctly rebuilds instead of silently
+        # measuring the previous stage's stem
+        want_stem = st.get("stem") or os.environ.get(
+            "HVD_TPU_BENCH_STEM", "conv")
         try:
-            if rig is None or rig.batch_per_chip != b:
+            if rig is None or rig.batch_per_chip != b \
+                    or want_stem != rig.stem:
                 # free donated buffers before allocating the next batch
                 rig = None
-                rig = _Rig(b, image_size, model_name, optimizer_name)
+                rig = _Rig(b, image_size, model_name, optimizer_name,
+                           stem=want_stem)
             yield rig.run_stage(st["num_warmup_batches"],
                                 st["num_batches_per_iter"],
                                 st["num_iters"],

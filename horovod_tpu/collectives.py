@@ -781,7 +781,7 @@ def grouped_broadcast_async(tensors: Sequence, root_rank: int,
     tl.start(base, "grouped_broadcast")
     wm = process_set or w.world_mesh
     nproc = wm.num_procs
-    locals_ = [np.asarray(t) for t in tensors]
+    locals_ = [_stage_input(t) for t in tensors]
     if not (0 <= root_rank < nproc):
         _finish(w, h)
         raise ValueError(f"root_rank {root_rank} out of range for world "
@@ -842,6 +842,9 @@ def alltoall_async(tensor, splits=None, name: Optional[str] = None,
     tl.start(name, "alltoall")
     wm = process_set or w.world_mesh
     nproc = wm.num_procs
+    # alltoall keeps the numpy coercion deliberately: its dispatch packs
+    # per-destination chunks into a fresh host buffer, and slicing a jax
+    # array per destination would trade ONE readback for nproc of them.
     local = np.asarray(tensor)
     try:
         if splits is None:
