@@ -121,7 +121,10 @@ def probe_backend():
     backoff = 5
     while True:
         remaining = _remaining()
-        if remaining <= CPU_RESERVE_S + 10:
+        # Always make at least ONE probe — a healthy backend answers in
+        # seconds, and a small custom budget must not auto-surrender a
+        # working TPU to the CPU fallback.
+        if attempt > 0 and remaining <= CPU_RESERVE_S + 10:
             _log(f"probe: {remaining:.0f}s left <= CPU reserve "
                  f"{CPU_RESERVE_S:.0f}s; giving up on accelerator after "
                  f"{attempt} attempts")

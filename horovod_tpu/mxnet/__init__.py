@@ -128,6 +128,11 @@ def DistributedTrainer(params, optimizer, optimizer_params=None,
     mx = _require_mxnet()
     from .. import basics as _basics
 
+    if gradient_predivide_factor <= 0:
+        raise ValueError(
+            f"gradient_predivide_factor must be positive, got "
+            f"{gradient_predivide_factor}")
+
     class _DistributedTrainer(mx.gluon.Trainer):
         def __init__(self, params_, optimizer_, optimizer_params_):
             if type(optimizer_).__name__ == "_Dist":

@@ -12,6 +12,7 @@ PORT pointing at the launcher's KV store.
 """
 
 import os
+import re
 import shlex
 import socket
 import threading
@@ -30,7 +31,10 @@ _LOCAL_NAMES = {"localhost", "127.0.0.1", "::1"}
 def is_local_host(hostname: str) -> bool:
     # The whole 127/8 block is loopback, not just 127.0.0.1 — multi-"host"
     # single-machine tests use 127.0.0.2 etc. as distinct host identities.
-    if hostname in _LOCAL_NAMES or hostname.startswith("127."):
+    # IP LITERALS only: "127" is a legal DNS label, so a name like
+    # 127.eu.example.com must still be treated as remote.
+    if hostname in _LOCAL_NAMES or re.fullmatch(
+            r"127\.\d{1,3}\.\d{1,3}\.\d{1,3}", hostname):
         return True
     try:
         return hostname in (socket.gethostname(), socket.getfqdn())

@@ -174,7 +174,8 @@ class HorovodEstimator(EstimatorParams):
                     f"validation={self.validation!r}: column-name "
                     "validation is not supported by this estimator; pass "
                     "a fraction in [0, 1) to split the materialized "
-                    "dataset (reference estimator `validation` param).")
+                    "dataset (reference estimator `validation` "
+                    "param).") from None
             if not 0.0 <= frac < 1.0:
                 raise ValueError(
                     f"validation must be a fraction in [0, 1), got "

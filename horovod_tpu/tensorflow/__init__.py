@@ -143,9 +143,16 @@ def allgather(tensor, name: Optional[str] = None):
     (functions.allgather_grad_numpy)."""
     tf = _tf()
     from ..functions import allgather_grad_numpy
-    nd = np.ndim(tensor) if isinstance(tensor, np.ndarray) \
-        else tensor.shape.rank
-    dim0 = int(tensor.shape[0]) if nd else 1
+    shape = getattr(tensor, "shape", ())
+    # tf shapes expose .rank (None when unknown); numpy arrays/scalars and
+    # plain sequences go through np.ndim
+    nd = shape.rank if hasattr(shape, "rank") else np.ndim(tensor)
+    if nd is None:
+        raise ValueError(
+            "allgather requires a statically known rank (the gradient "
+            "narrows this process's rows by its static dim0); got a "
+            "tensor of unknown rank")
+    dim0 = int(shape[0]) if nd else 1
 
     @tf.custom_gradient
     def _differentiable(x):

@@ -38,9 +38,9 @@ def _log(msg):
 
 
 def _free_port():
-    with socket.socket() as s:
-        s.bind(("127.0.0.1", 0))
-        return s.getsockname()[1]
+    sys.path.insert(0, ROOT)
+    from horovod_tpu.runner.launch import free_port
+    return free_port()
 
 
 def _cpu_env(extra=None):
