@@ -143,10 +143,16 @@ def allgather(tensor, name: Optional[str] = None):
     (functions.allgather_grad_numpy)."""
     tf = _tf()
     from ..functions import allgather_grad_numpy
-    shape = getattr(tensor, "shape", ())
-    # tf shapes expose .rank (None when unknown); numpy arrays/scalars and
-    # plain sequences go through np.ndim
-    nd = shape.rank if hasattr(shape, "rank") else np.ndim(tensor)
+    if not hasattr(tensor, "dtype"):
+        tensor = np.asarray(tensor)   # plain sequences/scalars
+    shape = getattr(tensor, "shape", None)
+    # tf shapes expose .rank (None when unknown); numpy arrays/scalars
+    # go through np.shape
+    if hasattr(shape, "rank"):
+        nd = shape.rank
+    else:
+        shape = np.shape(tensor)
+        nd = len(shape)
     if nd is None:
         raise ValueError(
             "allgather requires a statically known rank (the gradient "

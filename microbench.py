@@ -23,7 +23,6 @@ Usage: ``python microbench.py [--quick]``. Workers are internal
 
 import json
 import os
-import socket
 import subprocess
 import sys
 import time
@@ -38,7 +37,6 @@ def _log(msg):
 
 
 def _free_port():
-    sys.path.insert(0, ROOT)
     from horovod_tpu.runner.launch import free_port
     return free_port()
 
