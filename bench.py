@@ -84,12 +84,19 @@ TPU_LAST_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
 
 
 def _persist_tpu_best(d):
+    # atomic write: a kill mid-dump must not destroy the previous good
+    # record (the whole point is surviving ungraceful exits)
+    tmp = f"{TPU_LAST_PATH}.tmp.{os.getpid()}"
     try:
-        with open(TPU_LAST_PATH, "w") as f:
+        with open(tmp, "w") as f:
             json.dump({**d, "recorded_at": time.strftime(
                 "%Y-%m-%d %H:%M:%S")}, f, indent=1)
+        os.replace(tmp, TPU_LAST_PATH)
     except OSError:
-        pass
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
 
 
 def _log(msg):
@@ -395,8 +402,10 @@ def main():
         # PJRT relay, which dials the device at interpreter startup): the
         # CPU fallback must not depend on accelerator reachability.
         env.pop("PALLAS_AXON_POOL_IPS", None)
+        # truncate the (potentially traceback-heavy) probe error FIRST so
+        # it can never push the hardware-evidence citation past the cap
         note = ("accelerator unavailable; reduced CPU run. "
-                + (probe_err or ""))
+                + (probe_err or "")).strip()[:600]
         if os.path.exists(TPU_LAST_PATH):
             try:
                 with open(TPU_LAST_PATH) as f:

@@ -1,0 +1,77 @@
+"""Unit tests for the headline benchmark harness (bench.py).
+
+The bench is the round's scoreboard artifact, so its budget/probe/
+persistence logic deserves the same coverage as library code. These
+tests monkeypatch the subprocess probe — no accelerator needed.
+"""
+
+import json
+import sys
+import time
+import types
+
+import pytest
+
+
+@pytest.fixture
+def bench(monkeypatch, tmp_path):
+    import bench as b
+    # never touch the repo's real persisted artifact from tests
+    monkeypatch.setattr(b, "TPU_LAST_PATH", str(tmp_path / "last.json"))
+    return b
+
+
+def _fake_run_ok(*a, **kw):
+    return types.SimpleNamespace(
+        stdout="PROBE_OK|tpu|TPU v5 lite|1\n", stderr="", returncode=0)
+
+
+def _fake_run_fail(*a, **kw):
+    return types.SimpleNamespace(stdout="", stderr="boom", returncode=1)
+
+
+def test_probe_succeeds_even_with_tiny_budget(bench, monkeypatch):
+    """A healthy backend must win even when budget <= CPU reserve: at
+    least one probe always runs (r4 review fix)."""
+    monkeypatch.setattr(bench, "DEADLINE", time.time() + 95)  # reserve=90
+    monkeypatch.setattr(bench.subprocess, "run", _fake_run_ok)
+    info, err = bench.probe_backend()
+    assert info == {"platform": "tpu", "device_kind": "TPU v5 lite",
+                    "num_devices": 1}
+
+
+def test_probe_gives_up_inside_cpu_reserve(bench, monkeypatch):
+    """With a broken backend and a small budget, the probe concedes
+    after its guaranteed attempt, leaving the CPU reserve intact."""
+    monkeypatch.setattr(bench, "DEADLINE", time.time() + 95)
+    monkeypatch.setattr(bench.subprocess, "run", _fake_run_fail)
+    t0 = time.time()
+    info, err = bench.probe_backend()
+    assert info is None
+    assert "probe attempt 1" in err
+    assert time.time() - t0 < 30
+
+
+def test_persist_and_fallback_note_round_trip(bench, tmp_path):
+    """Accelerator best lines persist with a timestamp; the stored file
+    is what the CPU-fallback note cites."""
+    d = {"metric": "resnet50_synthetic_images_per_sec_per_chip",
+         "value": 2404.65, "unit": "images/sec/chip", "backend": "tpu",
+         "mfu": 0.3003}
+    bench._persist_tpu_best(d)
+    stored = json.load(open(bench.TPU_LAST_PATH))
+    assert stored["value"] == 2404.65
+    assert stored["backend"] == "tpu"
+    assert "recorded_at" in stored
+
+
+def test_result_json_carries_mfu(bench):
+    r = types.SimpleNamespace(
+        images_per_sec_per_chip=2000.0, images_per_sec_total=2000.0,
+        num_chips=1, batch_per_chip=128, device_kind="TPU v5 lite",
+        mfu=0.28, flops_per_step=3.06e12)
+    out = bench._result_json(r, "tpu")
+    assert out["mfu"] == 0.28
+    assert out["backend"] == "tpu"
+    assert out["vs_baseline"] == pytest.approx(
+        2000.0 / (1656.82 / 16), rel=1e-3)
