@@ -122,6 +122,10 @@ _MPI_FAMILIES = (
      "JSM_NAMESPACE_LOCAL_RANK", "JSM_NAMESPACE_LOCAL_SIZE"),
     ("SLURM_PROCID", "SLURM_STEP_NUM_TASKS",
      "SLURM_LOCALID", "SLURM_STEP_TASKS_PER_NODE"),
+    # MPICH / Hydra (also Intel MPI): PMI_* identity plus MPICH's
+    # per-node MPI_LOCAL* pair (reference docs/mpirun.rst lists bare
+    # `mpiexec.hydra` launches; runner/mpi_run.py drives this family).
+    ("PMI_RANK", "PMI_SIZE", "MPI_LOCALRANKID", "MPI_LOCALNRANKS"),
 )
 
 

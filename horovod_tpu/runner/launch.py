@@ -28,7 +28,8 @@ def free_port() -> int:
         return s.getsockname()[1]
 
 
-def check_ssh(hostnames: List[str], timeout: float = 10.0) -> List[str]:
+def check_ssh(hostnames: List[str], timeout: float = 10.0,
+              port: int = None) -> List[str]:
     """Return the subset of non-local hosts unreachable over passwordless ssh,
     probed concurrently (reference launch.py:55-108
     _check_all_hosts_ssh_successful uses a thread per host)."""
@@ -36,9 +37,10 @@ def check_ssh(hostnames: List[str], timeout: float = 10.0) -> List[str]:
 
     def probe(h: str) -> bool:
         try:
+            port_args = ["-p", str(port)] if port else []
             r = subprocess.run(
                 ["ssh", "-o", "BatchMode=yes", "-o", "StrictHostKeyChecking=no",
-                 "-o", f"ConnectTimeout={int(timeout)}", h, "true"],
+                 "-o", f"ConnectTimeout={int(timeout)}", *port_args, h, "true"],
                 capture_output=True, timeout=timeout + 5)
             return r.returncode == 0
         except (subprocess.TimeoutExpired, FileNotFoundError):
@@ -79,12 +81,34 @@ def make_parser() -> argparse.ArgumentParser:
     g.add_argument("--output-filename", dest="output_filename", default=None,
                    help="Directory for per-rank log files instead of "
                         "interleaved stdout.")
-    g.add_argument("--launcher", choices=("auto", "local", "jsrun"),
+    g.add_argument("--launcher", choices=("auto", "local", "jsrun", "mpi"),
                    default="auto",
                    help="Worker spawn mechanism: 'local' = ssh/local exec, "
                         "'jsrun' = IBM LSF resource sets (reference "
-                        "js_run.py), 'auto' picks jsrun inside an LSF job "
-                        "when jsrun is installed.")
+                        "js_run.py), 'mpi' = mpirun (reference mpi_run.py), "
+                        "'auto' picks jsrun inside an LSF job when jsrun is "
+                        "installed, else mpirun when installed and the host "
+                        "list spans remote machines, else local/ssh.")
+    g.add_argument("--mpi", action="store_true", dest="use_mpi",
+                   help="Shorthand for --launcher mpi (reference --mpi).")
+    g.add_argument("--gloo", action="store_true", dest="use_gloo",
+                   help="Force the built-in ssh/local launcher (the role "
+                        "gloo plays in the reference; the data plane is "
+                        "always XLA here). Shorthand for --launcher local.")
+    g.add_argument("--mpi-args", dest="mpi_args", default="",
+                   help="Extra arguments appended to the mpirun command "
+                        "line (reference --mpi-args).")
+    g.add_argument("--ssh-port", dest="ssh_port", type=int, default=None,
+                   help="SSH port for remote workers (mpirun rsh agent and "
+                        "the ssh precheck).")
+    g.add_argument("--network-interfaces", dest="nics", default=None,
+                   help="Comma-separated NICs MPI's TCP transports may use "
+                        "(reference --network-interfaces).")
+    g.add_argument("--tcp", action="store_true", dest="tcp_flag",
+                   help="Spectrum MPI only: force TCP transport.")
+    g.add_argument("--binding-args", dest="binding_args", default="",
+                   help="Override the per-implementation process binding "
+                        "defaults, e.g. '-bind-to core'.")
     g.add_argument("--disable-ssh-check", action="store_true",
                    dest="disable_ssh_check")
 
@@ -231,18 +255,12 @@ def _run_jsrun(args) -> int:
         # The JAX coordinator is BOUND by rank 0, which jsrun places on the
         # first compute host — not on this batch host (same rule as
         # _run_static's slots[0].hostname). A free_port() probe here would
-        # test availability on the WRONG machine, so pick deterministically
-        # from 61000-65499: ABOVE Linux's default ephemeral outgoing range
-        # (32768-60999), so a random outgoing connection on the compute
-        # host cannot squat the port — only another long-lived listener
-        # can. A stable crc32 of the LSF job id de-conflicts concurrent
-        # jobs sharing a compute node (builtin hash() is salted per
-        # interpreter and would not be stable).
-        import zlib
+        # test availability on the WRONG machine, so derive a stable port
+        # from the LSF job id (rationale in stable_coordinator_port).
+        from .mpi_run import stable_coordinator_port
         coord_host = slots[0].hostname if slots else socket.gethostname()
         seed = os.environ.get("LSB_JOBID", str(os.getpid()))
-        coord_port = 61000 + (zlib.crc32(
-            f"hvd-tpu-coord-{seed}".encode()) % 4500)
+        coord_port = stable_coordinator_port(f"hvd-tpu-coord-{seed}")
         base_env["HVD_TPU_COORDINATOR_ADDR"] = f"{coord_host}:{coord_port}"
         base_env["HVD_TPU_SIZE"] = str(np)
         base_env["HVD_TPU_RENDEZVOUS_ADDR"] = socket.gethostname()
@@ -263,7 +281,7 @@ def _run_static(args) -> int:
     hosts = _resolve_hosts(args)
     np = args.np or sum(h.slots for h in hosts)
     if not args.disable_ssh_check:
-        bad = check_ssh([h.hostname for h in hosts])
+        bad = check_ssh([h.hostname for h in hosts], port=args.ssh_port)
         if bad:
             raise RuntimeError(
                 f"hosts not reachable over passwordless ssh: {sorted(bad)}")
@@ -294,6 +312,80 @@ def _run_static(args) -> int:
     return 0
 
 
+def _run_mpi(args, impl=None) -> int:
+    """Launch workers through mpirun (reference: runner/mpi_run.py).
+
+    MPI is the process launcher only; each worker recovers rank identity
+    from the MPI-set env (config.py _MPI_FAMILIES) and joins the JAX
+    coordinator whose address is injected into the worker env here.
+    """
+    from .mpi_run import MPISettings, mpi_run
+
+    hosts = _resolve_hosts(args)
+    np = args.np or sum(h.slots for h in hosts)
+    if not args.disable_ssh_check:
+        # mpirun's rsh launcher needs the same passwordless ssh as the
+        # built-in launcher; failing here in seconds beats an interactive
+        # password prompt buried inside ORTE.
+        bad = check_ssh([h.hostname for h in hosts], port=args.ssh_port)
+        if bad:
+            raise RuntimeError(
+                f"hosts not reachable over passwordless ssh: {sorted(bad)}")
+    hosts_str = ",".join(f"{h.hostname}:{h.slots}" for h in hosts)
+    settings = MPISettings(
+        num_proc=np,
+        hosts=hosts_str,
+        ssh_port=args.ssh_port,
+        nics=tuple(s.strip() for s in args.nics.split(",") if s.strip())
+        if args.nics else (),
+        extra_mpi_args=args.mpi_args,
+        binding_args=args.binding_args,
+        output_filename=args.output_filename,
+        tcp_flag=args.tcp_flag,
+        verbose=args.verbose,
+    )
+    env = config_parser.set_env_from_args(dict(os.environ), args)
+    return mpi_run(settings, env, list(args.command), impl=impl)
+
+
+def run_controller(use_mpi: bool, mpi_fn, use_jsrun: bool, js_fn,
+                   use_local: bool, local_fn, args=None) -> int:
+    """Select the launch backend (reference launch.py:629-659
+    run_controller, with gloo's role played by the built-in ssh/local
+    launcher — the data plane is always XLA, so 'local' is always built).
+
+    Explicit requests win; 'auto' prefers jsrun inside an LSF job, then
+    mpirun when one is installed AND the job spans remote hosts (local
+    single-host jobs gain nothing from MPI), then local/ssh.
+    """
+    from .lsf import LSFUtils, is_jsrun_installed
+    from . import mpi_run as _mpi
+
+    if use_local:
+        return local_fn()
+    if use_mpi:
+        impl = _mpi.get_mpi_implementation()
+        if impl in (_mpi.MISSING_IMPL, _mpi.UNKNOWN_IMPL):
+            raise RuntimeError(_mpi.MPI_NOT_FOUND_MSG)
+        return mpi_fn(impl)
+    if use_jsrun:
+        if not LSFUtils.using_lsf():
+            raise RuntimeError(
+                "--launcher jsrun requires an LSF job environment")
+        return js_fn()
+    # auto
+    if LSFUtils.using_lsf() and is_jsrun_installed():
+        return js_fn()
+    if args is not None:
+        hosts = _resolve_hosts(args)
+        spans_remote = any(not is_local_host(h.hostname) for h in hosts)
+        if spans_remote:
+            impl = _mpi.get_mpi_implementation()
+            if impl not in (_mpi.MISSING_IMPL, _mpi.UNKNOWN_IMPL):
+                return mpi_fn(impl)
+    return local_fn()
+
+
 def _run_elastic(args) -> int:
     try:
         from ..elastic.launcher import launch_elastic
@@ -319,13 +411,23 @@ def run_commandline(argv=None) -> int:
         return 2
     random.seed()
     if args.host_discovery_script or (args.min_np is not None):
+        if args.use_mpi or args.launcher in ("mpi", "jsrun"):
+            # Same restriction as the reference (launch.py _run: elastic
+            # is gloo-only); an explicit backend must not be dropped
+            # silently.
+            raise RuntimeError(
+                "elastic training (--min-np / --host-discovery-script) "
+                "uses the built-in launcher; it cannot be combined with "
+                "--mpi or --launcher mpi/jsrun")
         return _run_elastic(args)
-    from .lsf import LSFUtils, is_jsrun_installed
-    if args.launcher == "jsrun" or (
-            args.launcher == "auto" and LSFUtils.using_lsf()
-            and is_jsrun_installed()):
-        return _run_jsrun(args)
-    return _run_static(args)
+    return run_controller(
+        use_mpi=args.use_mpi or args.launcher == "mpi",
+        mpi_fn=lambda impl=None: _run_mpi(args, impl=impl),
+        use_jsrun=args.launcher == "jsrun",
+        js_fn=lambda: _run_jsrun(args),
+        use_local=args.use_gloo or args.launcher == "local",
+        local_fn=lambda: _run_static(args),
+        args=args)
 
 
 def main():

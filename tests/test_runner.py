@@ -379,8 +379,11 @@ class TestLSF:
             [("nodeA", 2), ("nodeB", 1)]
 
     def test_launcher_jsrun_selected(self, monkeypatch):
-        """--launcher jsrun routes to _run_jsrun (mocked)."""
+        """--launcher jsrun routes to _run_jsrun (mocked). Outside an LSF
+        job this is an error (reference run_controller launch.py:645-651),
+        so simulate the allocation."""
         from horovod_tpu.runner import launch
+        monkeypatch.setenv("LSB_JOBID", "123")
         called = {}
         monkeypatch.setattr(launch, "_run_jsrun",
                             lambda args: called.setdefault("jsrun", 0) or 0)
