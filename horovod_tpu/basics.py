@@ -91,7 +91,36 @@ def _jax():
     return jax
 
 
+def _identity_from_comm(comm, coordinator_address):
+    """Derive (coordinator_address, size, rank) from an MPI communicator
+    (reference: ``hvd.init(comm=...)`` / horovod_init_comm,
+    common/basics.py:33-65 — rank identity and rendezvous both ride the
+    caller's communicator instead of env vars).
+
+    ``comm`` is duck-typed on the mpi4py surface (``Get_rank``,
+    ``Get_size``, ``bcast``), so any communicator-shaped object works —
+    including a subcommunicator, in which case THIS job's world is that
+    subcomm (the reference's subset-communicator semantics). Rank 0 of
+    ``comm`` binds the JAX coordinator and broadcasts its address over
+    the communicator itself, so no launcher env contract is needed.
+    """
+    import socket
+
+    rank, size = int(comm.Get_rank()), int(comm.Get_size())
+    if size > 1 and coordinator_address is None:
+        addr = None
+        if rank == 0:
+            with socket.socket() as s:
+                s.bind(("0.0.0.0", 0))
+                port = s.getsockname()[1]
+            host = socket.gethostname()
+            addr = f"{host}:{port}"
+        coordinator_address = comm.bcast(addr, root=0)
+    return coordinator_address, size, rank
+
+
 def init(process_sets: Optional[Sequence[Sequence[int]]] = None,
+         comm=None,
          coordinator_address: Optional[str] = None,
          num_processes: Optional[int] = None,
          process_id: Optional[int] = None,
@@ -106,9 +135,17 @@ def init(process_sets: Optional[Sequence[Sequence[int]]] = None,
     the reference's HOROVOD_GLOO_RENDEZVOUS_ADDR / HOROVOD_RANK / HOROVOD_SIZE
     contract, gloo/gloo_context.cc:142-165).
 
+    ``comm``: an mpi4py communicator (or any object with
+    ``Get_rank/Get_size/bcast``) supplying identity AND rendezvous — the
+    reference's ``hvd.init(comm=...)`` (common/basics.py:33-65). A
+    subcommunicator makes this job's world exactly that subcomm. A LIST of
+    world ranks is the reference's other accepted form: it is turned into
+    an mpi4py subcommunicator of ``COMM_WORLD`` (requires mpi4py; only the
+    listed ranks may call ``init``).
+
     ``process_sets``: optional list of process-index lists, the analogue of
-    the reference's ``hvd.init(comm=ranks)`` subset communicators
-    (basics.py:33-65). Retrieve with :func:`process_set_mesh`.
+    the reference's subset communicators. Retrieve with
+    :func:`process_set_mesh`.
     """
     global _world
     with _lock:
@@ -116,6 +153,30 @@ def init(process_sets: Optional[Sequence[Sequence[int]]] = None,
             return
         cfg = _config.Config(config_overrides)
         w = World(cfg)
+
+        if comm is not None and isinstance(comm, (list, tuple)):
+            try:
+                from mpi4py import MPI
+            except ImportError as e:
+                raise ValueError(
+                    "init(comm=[ranks]) requires mpi4py to split "
+                    "COMM_WORLD; pass an mpi4py (sub)communicator or use "
+                    "process_sets instead") from e
+            ranks = sorted(set(comm))
+            # MPI_Comm_create_group is collective over the GROUP only and
+            # is erroneous from a non-member (unlike MPI_Comm_create's
+            # COMM_NULL contract), so membership must be checked first.
+            if MPI.COMM_WORLD.Get_rank() not in ranks:
+                raise ValueError(
+                    f"this process (COMM_WORLD rank "
+                    f"{MPI.COMM_WORLD.Get_rank()}) is not in "
+                    f"init(comm={ranks}); only listed ranks may call init")
+            comm = MPI.COMM_WORLD.Create_group(
+                MPI.COMM_WORLD.group.Incl(ranks))
+
+        if comm is not None:
+            coordinator_address, num_processes, process_id = \
+                _identity_from_comm(comm, coordinator_address)
 
         addr = coordinator_address or cfg.get(_config.COORDINATOR_ADDR) or None
         n = num_processes if num_processes is not None else cfg.get(_config.SIZE)

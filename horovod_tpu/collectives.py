@@ -115,6 +115,21 @@ def _get_program(w, key, builder):
     return fn
 
 
+_DTYPE_STR: dict = {}
+
+
+def _dtype_str(dt) -> str:
+    """Interned str(dtype). numpy's ``dtype.__str__`` costs ~7us a call
+    (it re-derives the name each time); the eager dispatch path asks for
+    it up to 2x per group member, which the round-5 profile showed as the
+    single largest Python cost of a grouped dispatch. np.dtype objects
+    hash in nanoseconds, so intern the mapping once."""
+    s = _DTYPE_STR.get(dt)
+    if s is None:
+        s = _DTYPE_STR[dt] = str(dt)
+    return s
+
+
 def _stage_input(t):
     """Coerce a collective input for staging WITHOUT forcing device data
     through the host: a fully-addressable jax array is used as-is
@@ -297,6 +312,10 @@ def _check_consistency(w, wm, name, shape, dtype, kind, extra=""):
         return
     if not w.config.get(_config.CHECK_CONSISTENCY):
         return
+    if callable(extra):
+        # grouped verbs pass their member-metadata blob lazily so the
+        # (hot) disabled/single-process paths never pay the formatting
+        extra = extra()
     fp = metadata_fingerprint(name, shape, dtype, kind, extra)
     cache = _response_cache(w)
     cache_key = (hash(wm.cache_key) & 0xFFFFFFFF) << 32 | fp
@@ -400,19 +419,84 @@ def _allreduce_impl(w, values, op, prescale_factor, postscale_factor,
         _combined_scale(op, nproc, prescale_factor, postscale_factor, v.dtype)
         for v in values]
 
-    if nproc == 1:
-        sig = ("allreduce1", tuple((tuple(v.shape), str(v.dtype)) for v in values),
-               tuple(scales), op.value)
+    # Fusion buffer, host side: grouped members that are still HOST
+    # (numpy) values are packed into ONE flat buffer per dtype before
+    # anything touches the device — one memcpy + one host→device transfer
+    # + one program argument per dtype group instead of one per member.
+    # This is the reference's MemcpyInFusionBuffer
+    # (fusion_buffer_manager.h:30-55, collective_operations.cc:37-81)
+    # relocated to where the bytes actually live at eager staging time.
+    # Members that are already device-resident jax arrays stay separate
+    # program args: host-packing those would force the readback
+    # _stage_input exists to avoid. The round-4 microbenchmark measured
+    # the per-member-staged grouped program at ~2x the latency of a
+    # single allreduce of the same payload below 128 KB — per-member
+    # device_put + N-ary dispatch, exactly the cost pre-packing
+    # amortizes (MICROBENCH.json, docs/tensor-fusion.md).
+    import math
+    shapes = [tuple(v.shape) for v in values]
+    dtypes = [_dtype_str(v.dtype) for v in values]
+    numels = [math.prod(s) for s in shapes]
 
+    # Host packing pays one extra full memcpy, so it is a win exactly
+    # where transfer-count overhead dominates and a loss where bandwidth
+    # does: small members pack, large members stay separate (their fusion
+    # still happens in-program via concatenate, where XLA overlaps the
+    # copies with the collective). The cutoff is per member — a bucket of
+    # 150 small grads packs wholesale while its few large conv kernels
+    # ride separately. 256 KB ≈ where the round-5 CPU sweep showed the
+    # packed path's advantage fading into the memcpy cost.
+    pack_cutoff = w.config.get(_config.PACK_CUTOFF)
+    host_groups: dict = {}
+    separate = []
+    for i, v in enumerate(values):
+        if isinstance(v, jax.Array) or v.nbytes > pack_cutoff:
+            separate.append(i)
+        else:
+            host_groups.setdefault(dtypes[i], []).append(i)
+    for dt in [d for d, idxs in host_groups.items() if len(idxs) == 1]:
+        separate.append(host_groups.pop(dt)[0])  # lone member: no packing
+    separate.sort()
+    packed_layout = tuple(sorted(
+        (dt, tuple(idxs)) for dt, idxs in host_groups.items()))
+
+    staged = [
+        np.concatenate([np.ravel(values[i]) for i in idxs])
+        for _dt, idxs in packed_layout
+    ] + [values[i] for i in separate]
+    # the program closures must capture only the PLAN (shapes/layout),
+    # never `values`: cached jits live for the process lifetime and would
+    # pin the first call's whole tensor list
+    n_members = len(values)
+
+    sig_members = (packed_layout, tuple(separate), tuple(shapes),
+                   tuple(dtypes), tuple(scales), op.value)
+
+    if nproc == 1:
         def build1():
-            def f(*vs):
-                # non-unit scales on integer dtypes already rejected above
-                return tuple(
-                    v if s == 1.0 else (v * s).astype(v.dtype)
-                    for v, s in zip(vs, scales))
+            def f(*args):
+                out = [None] * n_members
+                k = 0
+                for _dt, idxs in packed_layout:
+                    buf = args[k]
+                    k += 1
+                    off = 0
+                    for i in idxs:
+                        piece = buf[off:off + numels[i]]
+                        off += numels[i]
+                        if scales[i] != 1.0:
+                            piece = (piece * scales[i]).astype(buf.dtype)
+                        out[i] = piece.reshape(shapes[i])
+                for i in separate:
+                    v = args[k]
+                    k += 1
+                    # non-unit scales on int dtypes already rejected above
+                    out[i] = v if scales[i] == 1.0 \
+                        else (v * scales[i]).astype(v.dtype)
+                return tuple(out)
             return jax.jit(f)
-        fn = _get_program(w, sig, build1)
-        return list(fn(*values))
+        fn = _get_program(w, ("allreduce1",) + sig_members, build1)
+        return list(fn(*staged))
 
     reducer = {
         ReduceOp.AVERAGE: jnp.sum, ReduceOp.SUM: jnp.sum,
@@ -420,60 +504,56 @@ def _allreduce_impl(w, values, op, prescale_factor, postscale_factor,
         ReduceOp.PRODUCT: jnp.prod,
     }[op]
 
-    sig = ("allreduce", nproc, wm.cache_key,
-           tuple((tuple(v.shape), str(v.dtype)) for v in values),
-           tuple(scales), op.value)
+    sig = ("allreduce", nproc, wm.cache_key) + sig_members
 
     def build():
-        # Fusion buffer, in-program: same-dtype group members are packed
-        # into ONE flat buffer before the reduction so XLA emits one
-        # cross-process collective per dtype group instead of one per
-        # tensor (reference: fusion_buffer_manager.h:30-55,
-        # MemcpyInFusionBuffer/Out, collective_operations.cc:37-81). The
-        # round-4 microbenchmark measured the unfused grouped program at
-        # ~6x the latency of a single allreduce of the same payload at 2
-        # processes — per-collective launch latency dominates grouped
-        # members, exactly the cost the reference's fusion buffer
-        # amortizes (MICROBENCH.json, docs/tensor-fusion.md).
-        shapes = [tuple(v.shape) for v in values]
-        numels = [int(np.prod(s)) if s else 1 for s in shapes]
-        groups: dict = {}
-        for i, v in enumerate(values):
-            groups.setdefault(str(v.dtype), []).append(i)
-
+        # In-program half of the fusion buffer: each pre-packed host
+        # buffer reduces as ONE cross-process collective carrying all its
+        # small members; each large member gets its own collective. Large
+        # members are deliberately NOT concatenated in-program: the
+        # concat+slice would copy every byte twice more, and at large
+        # sizes collectives are bandwidth-bound — per-launch overhead is
+        # already amortized (the round-5 2-proc measurement showed the
+        # concat variant ~2x slower than per-member collectives on a
+        # 97 MB ResNet-50 gradient set, while for small members the
+        # packed buffer is what kills the per-launch cost).
         def _reduce1(g):
             acc = g
             if g.dtype == jnp.bfloat16 or g.dtype == jnp.float16:
                 acc = g.astype(jnp.float32)  # accumulate halfs in fp32
             return reducer(acc, axis=0)
 
-        def f(*stacked):
-            out = [None] * len(stacked)
-            for idxs in groups.values():
-                if len(idxs) == 1:
-                    i = idxs[0]
-                    r = _reduce1(stacked[i])
-                    if scales[i] != 1.0:
-                        r = r * scales[i]
-                    out[i] = r.astype(stacked[i].dtype)
-                    continue
-                buf = jnp.concatenate(
-                    [stacked[i].reshape((nproc, numels[i])) for i in idxs],
-                    axis=1)
-                r = _reduce1(buf)
+        def f(*args):
+            k = 0
+            out = [None] * n_members
+            for _dt, idxs in packed_layout:
+                r = _reduce1(args[k].reshape((nproc, -1)))
+                k += 1
                 off = 0
                 for i in idxs:
                     piece = r[off:off + numels[i]]
                     off += numels[i]
                     if scales[i] != 1.0:
                         piece = piece * scales[i]
-                    out[i] = piece.reshape(shapes[i]).astype(
-                        stacked[i].dtype)
+                    out[i] = piece.reshape(shapes[i]).astype(dtypes[i])
+            for i in separate:
+                r = _reduce1(args[k])
+                k += 1
+                if scales[i] != 1.0:
+                    r = r * scales[i]
+                out[i] = r.astype(dtypes[i])
             return tuple(out)
         return jax.jit(f, out_shardings=wm.replicated_sharding())
     fn = _get_program(w, sig, build)
 
-    globals_ = [_global_from_local(wm, v) for v in values]
+    # One batched device_put for every staged buffer: the runtime moves
+    # the transfers as a group (parallel memcpy / DMA) instead of N
+    # Python-sequenced ones.
+    shards = jax.device_put([v[None] for v in staged], wm.anchor_device)
+    globals_ = [
+        jax.make_array_from_single_device_arrays(
+            (nproc,) + tuple(v.shape), wm.stacked_sharding(), [sh])
+        for v, sh in zip(staged, shards)]
     outs = fn(*globals_)
     if not isinstance(outs, tuple):
         outs = (outs,)
@@ -520,7 +600,7 @@ def allreduce_async(tensor, average=None, name: Optional[str] = None,
         raise
 
     _record_round(w, ("allreduce", name, tuple(local.shape),
-                      str(local.dtype), op.value, prescale_factor,
+                      _dtype_str(local.dtype), op.value, prescale_factor,
                       postscale_factor))
     # Snapshot join state at submit time: a collective submitted before
     # join() must carry real data even if the dispatcher runs it after.
@@ -594,7 +674,7 @@ def grouped_allreduce_async(tensors: Sequence, average=None,
         raise
 
     shapes = tuple(tuple(l.shape) for l in locals_)
-    dtypes = tuple(str(l.dtype) for l in locals_)
+    dtypes = tuple(_dtype_str(l.dtype) for l in locals_)
     _record_round(w, ("grouped_allreduce", base, shapes, dtypes,
                       op.value, prescale_factor, postscale_factor))
     joined_at_submit = w.joined
@@ -604,7 +684,7 @@ def grouped_allreduce_async(tensors: Sequence, average=None,
         # full member metadata through the free-form ``extra`` lane.
         _check_consistency(w, wm, base, (len(locals_),), "grouped",
                            "grouped_allreduce",
-                           extra=f"{shapes}|{dtypes}|{op.value}")
+                           extra=lambda: f"{shapes}|{dtypes}|{op.value}")
         tl.activity_start(base, _tl.XLA_ALLREDUCE)
         vals = [np.zeros(l.shape, l.dtype) for l in locals_] \
             if joined_at_submit else locals_
@@ -638,7 +718,7 @@ def allgather_async(tensor, name: Optional[str] = None, process_set=None) -> int
     wm = process_set or w.world_mesh
     local = _stage_input(tensor)
     _record_round(w, ("allgather", name, tuple(local.shape),
-                      str(local.dtype)))
+                      _dtype_str(local.dtype)))
 
     def dispatch():
         jax, jnp = _jax(), _jnp()
@@ -666,7 +746,7 @@ def allgather_async(tensor, name: Optional[str] = None, process_set=None) -> int
                                out_shardings=wm.replicated_sharding())
             fn = _get_program(
                 w, ("allgather_uniform", nproc, wm.cache_key,
-                    shape, str(local.dtype)), build)
+                    shape, _dtype_str(local.dtype)), build)
             result = _local_result(fn(garr))
         else:
             # ragged: pad to max, gather, slice+concat with static sizes.
@@ -686,7 +766,7 @@ def allgather_async(tensor, name: Optional[str] = None, process_set=None) -> int
                 return jax.jit(f, out_shardings=wm.replicated_sharding())
             fn = _get_program(
                 w, ("allgather_ragged", nproc, wm.cache_key, sizes_t,
-                    padded.shape, str(local.dtype)), build)
+                    padded.shape, _dtype_str(local.dtype)), build)
             result = _local_result(fn(garr))
         tl.activity_end(name)
         return result
@@ -733,7 +813,7 @@ def broadcast_async(tensor, root_rank: int, name: Optional[str] = None,
         raise ValueError(f"root_rank {root_rank} out of range for world "
                          f"size {nproc}")
     _record_round(w, ("broadcast", name, tuple(local.shape),
-                      str(local.dtype), root_rank))
+                      _dtype_str(local.dtype), root_rank))
 
     def dispatch():
         jax, jnp = _jax(), _jnp()
@@ -749,7 +829,7 @@ def broadcast_async(tensor, root_rank: int, name: Optional[str] = None,
                            out_shardings=wm.replicated_sharding())
         fn = _get_program(
             w, ("broadcast", nproc, wm.cache_key, root_rank,
-                local.shape, str(local.dtype)), build)
+                local.shape, _dtype_str(local.dtype)), build)
         result = _local_result(fn(garr))
         tl.activity_end(name)
         return result
@@ -787,14 +867,14 @@ def grouped_broadcast_async(tensors: Sequence, root_rank: int,
         raise ValueError(f"root_rank {root_rank} out of range for world "
                          f"size {nproc}")
     shapes = tuple(tuple(l.shape) for l in locals_)
-    dtypes = tuple(str(l.dtype) for l in locals_)
+    dtypes = tuple(_dtype_str(l.dtype) for l in locals_)
     _record_round(w, ("grouped_broadcast", base, shapes, dtypes, root_rank))
 
     def dispatch():
         jax, jnp = _jax(), _jnp()
         _check_consistency(w, wm, base, (len(locals_),), "grouped",
                            "grouped_broadcast",
-                           extra=f"{shapes}|{dtypes}|{root_rank}")
+                           extra=lambda: f"{shapes}|{dtypes}|{root_rank}")
         if nproc == 1:
             return [jnp.asarray(l) for l in locals_]
         tl.activity_start(base, _tl.XLA_BROADCAST)
@@ -861,7 +941,7 @@ def alltoall_async(tensor, splits=None, name: Optional[str] = None,
         _finish(w, h)
         raise
     _record_round(w, ("alltoall", name, tuple(local.shape),
-                      str(local.dtype), tuple(splits)))
+                      _dtype_str(local.dtype), tuple(splits)))
 
     def dispatch():
         jax, jnp = _jax(), _jnp()
@@ -889,7 +969,7 @@ def alltoall_async(tensor, splits=None, name: Optional[str] = None,
                            out_shardings=wm.stacked_sharding())
         fn = _get_program(
             w, ("alltoall", nproc, wm.cache_key, chunks.shape,
-                str(local.dtype)), build)
+                _dtype_str(local.dtype)), build)
         # my shard: (1, src, maxs, *rest) — rows every src sent to me
         mine = np.asarray(_local_result(fn(garr)))[0]
         incoming = [int(split_tbl[src, wm.my_index])

@@ -52,6 +52,12 @@ def _register(name, default, parser, alias=None, help=""):
 FUSION_THRESHOLD = _register(
     "FUSION_THRESHOLD", 64 * 1024 * 1024, int, alias="HOROVOD_FUSION_THRESHOLD",
     help="Gradient-bucket fusion threshold in bytes (0 disables fusion).")
+PACK_CUTOFF = _register(
+    "PACK_CUTOFF", 256 * 1024, int,
+    help="Grouped-collective members at or below this many bytes are packed "
+         "into one host buffer per dtype before staging (one transfer per "
+         "group); larger members stage separately and fuse in-program. "
+         "0 disables host packing.")
 CYCLE_TIME = _register(
     "CYCLE_TIME", 1.0, float, alias="HOROVOD_CYCLE_TIME",
     help="Async-coordinator cycle time in milliseconds.")
@@ -157,6 +163,17 @@ def mpi_task_identity(environ=None, with_source: bool = False):
                     out[key] = parse(v)
                 except ValueError:
                     pass
+        # MPI launchers export no cross-host identity; with host-major
+        # rank placement and uniform slots (mpirun's default map-by slot
+        # over -H h:n lists, and ppr mappings) the cross triple is
+        # derivable: the host index and host count. Non-uniform layouts
+        # (size % local_size != 0) stay unset rather than guessed —
+        # basics falls back to its defaults there (reference: cross comm
+        # from MPI_Comm_split by local_rank, mpi_context.cc:147-156).
+        ls = out.get("LOCAL_SIZE")
+        if ls and ls > 0 and out["SIZE"] % ls == 0:
+            out.setdefault("CROSS_RANK", out["RANK"] // ls)
+            out.setdefault("CROSS_SIZE", out["SIZE"] // ls)
         return (out, rank_var) if with_source else out
     return ({}, None) if with_source else {}
 CROSS_RANK = _register("CROSS_RANK", -1, int, alias="HOROVOD_CROSS_RANK")
@@ -242,7 +259,8 @@ class Config:
             src = f"env {alias}"
         if raw is None:
             # external-scheduler fallback for the task-identity knobs
-            if name in (RANK, SIZE, LOCAL_RANK, LOCAL_SIZE):
+            if name in (RANK, SIZE, LOCAL_RANK, LOCAL_SIZE,
+                        CROSS_RANK, CROSS_SIZE):
                 ident, family = mpi_task_identity(with_source=True)
                 if name in ident:
                     return ident[name], f"scheduler {family}"

@@ -361,6 +361,13 @@ def run_controller(use_mpi: bool, mpi_fn, use_jsrun: bool, js_fn,
     from .lsf import LSFUtils, is_jsrun_installed
     from . import mpi_run as _mpi
 
+    if use_local and (use_mpi or use_jsrun):
+        # the reference horovodrun errors on --mpi --gloo; dropping an
+        # explicit backend silently is the failure mode run_controller
+        # exists to prevent
+        raise RuntimeError(
+            "contradictory launcher selection: --gloo/--launcher local "
+            "together with --mpi/--launcher mpi/jsrun")
     if use_local:
         return local_fn()
     if use_mpi:

@@ -54,8 +54,8 @@ class EstimatorParams:
     _param_names: List[str] = [
         "model", "optimizer", "loss", "metrics", "feature_cols",
         "label_cols", "output_cols", "batch_size", "epochs",
-        "validation", "num_proc", "store", "run_id", "verbose", "shuffle",
-        "random_seed",
+        "validation", "sample_weight_col", "num_proc", "store", "run_id",
+        "verbose", "shuffle", "random_seed",
     ]
 
     def __init__(self, **kwargs):
@@ -68,7 +68,12 @@ class EstimatorParams:
         self.output_cols: Optional[List[str]] = None
         self.batch_size = 32
         self.epochs = 1
-        self.validation: Optional[float] = None
+        #: float fraction in [0, 1) OR a column name whose rows with
+        #: value > 0 form the validation set (both reference forms,
+        #: spark/common/params.py `validation`)
+        self.validation = None
+        #: per-row training weight column (reference `sample_weight_col`)
+        self.sample_weight_col: Optional[str] = None
         self.num_proc: Optional[int] = None
         self.store: Optional[Store] = None
         self.run_id: Optional[str] = None
@@ -115,12 +120,45 @@ class HorovodEstimator(EstimatorParams):
             self.run_id = f"run_{int(time.time())}_{uuid.uuid4().hex[:8]}"
         return self.run_id
 
+    # -- validation spec -----------------------------------------------------
+    def _validation_spec(self):
+        """('fraction', f) | ('column', name) | None — the reference's two
+        `validation` forms (spark/common/params.py): a float fraction, or
+        the name of a column whose rows with value > 0 are validation."""
+        if self.validation is None:
+            return None
+        v = self.validation
+        if isinstance(v, str):
+            try:
+                v = float(v)   # numeric strings keep working as fractions
+            except ValueError:
+                return ("column", self.validation)
+        frac = float(v)
+        if not 0.0 <= frac < 1.0:
+            raise ValueError(
+                f"validation must be a fraction in [0, 1) or a column "
+                f"name, got {self.validation!r} (reference estimator "
+                f"`validation` param)")
+        return ("fraction", frac)
+
+    def _extra_cols(self) -> List[str]:
+        """Columns beyond features+labels that must ship in the Parquet."""
+        extra = []
+        spec = self._validation_spec()
+        if spec and spec[0] == "column":
+            extra.append(spec[1])
+        if self.sample_weight_col:
+            extra.append(self.sample_weight_col)
+        return extra
+
     # -- data materialization ------------------------------------------------
     def _materialize(self, df) -> str:
         """DataFrame -> Parquet under the store; returns the dataset path."""
         store = self._resolve_store()
         path = store.get_train_data_path(self._resolve_run_id())
-        cols = list(self.feature_cols) + list(self.label_cols)
+        cols = (list(self.feature_cols) + list(self.label_cols)
+                + self._extra_cols())
+        fs = getattr(store, "fs", None)
         if _is_spark_df(df):
             df.select(cols).write.mode("overwrite").parquet(path)
         else:
@@ -130,7 +168,7 @@ class HorovodEstimator(EstimatorParams):
                         else df[c].to_numpy() for c in cols}
             else:
                 data = {c: np.asarray(df[c]) for c in cols}
-            write_parquet(path, data)
+            write_parquet(path, data, fs=fs)
         return path
 
     # -- training dispatch ---------------------------------------------------
@@ -160,27 +198,7 @@ class HorovodEstimator(EstimatorParams):
         transformer (reference: estimator.py fit / _fit_on_prepared_data)."""
         # validate shared params BEFORE the (possibly expensive) Parquet
         # materialization, identically for every framework subclass
-        if self.validation is not None:
-            try:
-                frac = float(self.validation)
-            except (TypeError, ValueError):
-                # the reference also accepts a validation COLUMN NAME
-                # (rows with col value > 0 form the validation set); this
-                # estimator only implements the fraction form — reject a
-                # non-numeric string early with a targeted message instead
-                # of a bare float() ValueError. Numeric strings ("0.2")
-                # keep working as fractions.
-                raise ValueError(
-                    f"validation={self.validation!r}: column-name "
-                    "validation is not supported by this estimator; pass "
-                    "a fraction in [0, 1) to split the materialized "
-                    "dataset (reference estimator `validation` "
-                    "param).") from None
-            if not 0.0 <= frac < 1.0:
-                raise ValueError(
-                    f"validation must be a fraction in [0, 1), got "
-                    f"{self.validation} (reference estimator `validation` "
-                    f"param)")
+        self._validation_spec()
         train_path = self._materialize(df)
         train_fn = self._make_train_fn()
         result = self._run_distributed(train_fn, train_path)
@@ -192,6 +210,49 @@ class HorovodEstimator(EstimatorParams):
 
     def _make_model(self, train_result):
         raise NotImplementedError
+
+
+def load_split_shard(train_path: str, feature_cols: List[str],
+                     label_cols: List[str], rank: int, size: int,
+                     sample_weight_col: Optional[str] = None,
+                     validation_spec=None, fs=None):
+    """Read this worker's Parquet shard and split train/validation.
+
+    Returns ``(train_arrays, val_arrays_or_None, w_train, w_val)`` where
+    the array lists follow ``feature_cols + label_cols`` order. Implements
+    both reference validation forms (spark/common/params.py): a fraction
+    (tail rows of the shard) or a column whose rows with value > 0 are
+    validation; plus the per-row ``sample_weight_col``.
+    """
+    names = list(feature_cols) + list(label_cols)
+    val_col = (validation_spec[1]
+               if validation_spec and validation_spec[0] == "column"
+               else None)
+    extra = ([sample_weight_col] if sample_weight_col else []) \
+        + ([val_col] if val_col else [])
+    arrays = read_parquet_shard(train_path, names + extra, rank, size,
+                                fs=fs)
+    data = [np.asarray(a) for a in arrays[:len(names)]]
+    k = len(names)
+    w = np.asarray(arrays[k], dtype=np.float32) if sample_weight_col \
+        else None
+    if val_col:
+        vmask = np.asarray(arrays[-1]) > 0
+        train = [a[~vmask] for a in data]
+        val = [a[vmask] for a in data]
+        return (train, val,
+                w[~vmask] if w is not None else None,
+                w[vmask] if w is not None else None)
+    if validation_spec and validation_spec[0] == "fraction" \
+            and validation_spec[1] > 0:
+        n_val = int(len(data[0]) * validation_spec[1])
+        if n_val:
+            train = [a[:-n_val] for a in data]
+            val = [a[-n_val:] for a in data]
+            return (train, val,
+                    w[:-n_val] if w is not None else None,
+                    w[-n_val:] if w is not None else None)
+    return data, None, w, None
 
 
 class _SparkTrainTask:

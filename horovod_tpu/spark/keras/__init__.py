@@ -5,26 +5,28 @@ Reference: /root/reference/horovod/spark/keras/estimator.py:105-379 —
 serialize the compiled model on the driver, materialize the DataFrame as
 Parquet via the Store, train one worker per executor (DistributedOptimizer
 + initial broadcast), return a ``KerasModel`` transformer carrying the
-trained weights.
+trained weights. Round 5 adds the reference's remaining estimator depth:
+``custom_objects`` (estimator.py:150 custom layer/loss resolution on the
+workers), ``sample_weight_col``, and the validation-COLUMN form of
+``validation`` alongside the fraction form.
 """
 
 from typing import List, Optional
 
 import numpy as np
 
-from ..estimator import HorovodEstimator, HorovodModel
-from ..store import read_parquet_shard
+from ..estimator import HorovodEstimator, HorovodModel, load_split_shard
 
 
 def _serialize_keras(model):
-    import keras
     return {"config": model.to_json(),
             "weights": [np.array(w) for w in model.get_weights()]}
 
 
-def _deserialize_keras(blob):
+def _deserialize_keras(blob, custom_objects=None):
     import keras
-    model = keras.models.model_from_json(blob["config"])
+    model = keras.models.model_from_json(
+        blob["config"], custom_objects=custom_objects or {})
     model.set_weights(blob["weights"])
     return model
 
@@ -39,8 +41,20 @@ class KerasEstimator(HorovodEstimator):
         pred_df = keras_model.transform(df)
     """
 
+    _param_names: List[str] = HorovodEstimator._param_names + [
+        "custom_objects",
+    ]
+
+    def __init__(self, **kwargs):
+        #: name -> class/function mapping shipped to workers so custom
+        #: layers/losses deserialize (reference keras estimator
+        #: `custom_objects`)
+        self.custom_objects = None
+        super().__init__(**kwargs)
+
     def _make_train_fn(self):
         blob = _serialize_keras(self.model)
+        custom_objects = self.custom_objects
         optimizer = self.optimizer or "sgd"
         loss = self.loss or "mse"
         metrics = list(self.metrics or [])
@@ -49,14 +63,16 @@ class KerasEstimator(HorovodEstimator):
         batch_size, epochs = int(self.batch_size), int(self.epochs)
         shuffle, seed = bool(self.shuffle), int(self.random_seed)
         verbose = int(self.verbose)
-        validation = float(self.validation) if self.validation else 0.0
+        validation_spec = self._validation_spec()
+        sample_weight_col = self.sample_weight_col
+        fs = getattr(self._resolve_store(), "fs", None)
 
         def train_fn(rank: int, size: int, train_path: str):
             import keras
 
             from ... import tensorflow as hvd_tf
 
-            model = _deserialize_keras(blob)
+            model = _deserialize_keras(blob, custom_objects)
             if size > 1:
                 # initial weight broadcast (reference:
                 # BroadcastGlobalVariablesCallback role)
@@ -66,22 +82,28 @@ class KerasEstimator(HorovodEstimator):
                     for i, w in enumerate(ws)]
                 model.set_weights(ws)
 
-            cols = read_parquet_shard(
-                train_path, feature_cols + label_cols, rank, size)
-            x = _stack(cols[:len(feature_cols)])
-            y = _stack(cols[len(feature_cols):])
+            train, val, w_t, w_v = load_split_shard(
+                train_path, feature_cols, label_cols, rank, size,
+                sample_weight_col=sample_weight_col,
+                validation_spec=validation_spec, fs=fs)
+            x = _stack(train[:len(feature_cols)])
+            y = _stack(train[len(feature_cols):])
+            validation_data = None
+            if val is not None:
+                xv = _stack(val[:len(feature_cols)])
+                yv = _stack(val[len(feature_cols):])
+                validation_data = (xv, yv, w_v) if w_v is not None \
+                    else (xv, yv)
 
             opt = (keras.optimizers.get(optimizer)
                    if isinstance(optimizer, str) else optimizer)
             if size > 1:
                 opt = hvd_tf.DistributedOptimizer(opt)
             model.compile(optimizer=opt, loss=loss, metrics=metrics)
-            # validation fraction held out of this worker's shard
-            # (reference: estimator `validation` param, spark/common/
-            # params.py — val_* metrics land in the history)
             history = model.fit(x, y, batch_size=batch_size, epochs=epochs,
                                 shuffle=shuffle, verbose=verbose,
-                                validation_split=validation)
+                                sample_weight=w_t,
+                                validation_data=validation_data)
             return {"weights": [np.array(w) for w in model.get_weights()],
                     "history": {k: [float(v) for v in vs]
                                 for k, vs in history.history.items()}}
@@ -102,11 +124,13 @@ class KerasEstimator(HorovodEstimator):
         return train_fn
 
     def _make_model(self, train_result):
-        model = _deserialize_keras(_serialize_keras(self.model))
+        model = _deserialize_keras(_serialize_keras(self.model),
+                                   self.custom_objects)
         model.set_weights(train_result["weights"])
         return KerasModel(model, self.feature_cols, self.label_cols,
                           self.output_cols,
-                          history=train_result.get("history"))
+                          history=train_result.get("history"),
+                          custom_objects=self.custom_objects)
 
 
 class KerasModel(HorovodModel):
@@ -115,10 +139,12 @@ class KerasModel(HorovodModel):
 
     def __init__(self, model, feature_cols: List[str],
                  label_cols: List[str],
-                 output_cols: Optional[List[str]] = None, history=None):
+                 output_cols: Optional[List[str]] = None, history=None,
+                 custom_objects=None):
         super().__init__(feature_cols, label_cols, output_cols)
         self.model = model
         self.history = history or {}
+        self.custom_objects = custom_objects
 
     def getModel(self):
         return self.model

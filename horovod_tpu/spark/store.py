@@ -43,11 +43,24 @@ class Store:
 
     @staticmethod
     def create(prefix_path: str, *args, **kwargs) -> "Store":
+        """Route a path to a store backend by scheme (reference
+        store.py Store.create → LocalStore | HDFSStore). Remote schemes
+        (s3://, gs://, hdfs://, memory://, ...) go through fsspec when a
+        backend for the scheme is installed — the HDFSStore role,
+        generalized."""
         if "://" in prefix_path and not prefix_path.startswith("file://"):
             scheme = prefix_path.split("://", 1)[0]
-            raise ValueError(
-                f"no store backend for scheme {scheme!r} in this "
-                f"environment; use a local path (LocalStore)")
+            try:
+                import fsspec
+                fsspec.get_filesystem_class(scheme)
+            except ImportError:
+                raise ValueError(
+                    f"no store backend for scheme {scheme!r}: fsspec is "
+                    f"not installed; use a local path (LocalStore)")
+            except ValueError as e:
+                raise ValueError(
+                    f"no store backend for scheme {scheme!r}: {e}")
+            return FsspecStore(prefix_path, *args, **kwargs)
         return LocalStore(prefix_path.removeprefix("file://"),
                           *args, **kwargs)
 
@@ -106,19 +119,59 @@ class LocalStore(FilesystemStore):
         return sync
 
 
+class FsspecStore(FilesystemStore):
+    """Remote store over any fsspec filesystem — s3://, gs://, hdfs://,
+    memory:// (tests) ... (reference: store.py HDFSStore, generalized to
+    every scheme fsspec knows). Paths keep their scheme; the Parquet IO
+    helpers route through :attr:`fs` instead of the local filesystem."""
+
+    def __init__(self, prefix_path: str, *args, **kwargs):
+        import fsspec
+        scheme = prefix_path.split("://", 1)[0]
+        self.fs = fsspec.filesystem(scheme)
+        super().__init__(prefix_path, *args, **kwargs)
+
+    def _run_path(self, base: Optional[str], run_id: str, leaf: str) -> str:
+        # posix joins: remote object paths never use os.sep
+        if base:
+            return f"{base.rstrip('/')}/{run_id}"
+        return f"{self.prefix_path.rstrip('/')}/runs/{run_id}/{leaf}"
+
+    def exists(self, path: str) -> bool:
+        return self.fs.exists(path)
+
+    def makedirs(self, path: str) -> None:
+        self.fs.makedirs(path, exist_ok=True)
+
+    def sync_fn(self, run_id: str):
+        target = self.get_checkpoint_path(run_id)
+        fs = self.fs
+
+        def sync(local_dir: str) -> None:
+            fs.makedirs(target, exist_ok=True)
+            fs.put(local_dir.rstrip("/") + "/", target.rstrip("/") + "/",
+                   recursive=True)
+        return sync
+
+
 # ---------------------------------------------------------------------------
-# Parquet IO helpers (the Petastorm-equivalent data path)
+# Parquet IO helpers (the Petastorm-equivalent data path). ``fs=None``
+# means the local filesystem; estimators pass ``store.fs`` so the same
+# code streams local and remote datasets.
 # ---------------------------------------------------------------------------
 
 def write_parquet(path: str, columns: dict, row_group_rows: int = 4096,
-                  partitions: int = 1) -> None:
+                  partitions: int = 1, fs=None) -> None:
     """Write named numpy columns as one or more Parquet files under
     ``path`` (a directory, like a Spark parquet dataset)."""
     import numpy as np
     import pyarrow as pa
     import pyarrow.parquet as pq
 
-    os.makedirs(path, exist_ok=True)
+    if fs is None:
+        os.makedirs(path, exist_ok=True)
+    else:
+        fs.makedirs(path, exist_ok=True)
     n = len(next(iter(columns.values())))
     per = (n + partitions - 1) // partitions
     for p in range(partitions):
@@ -133,25 +186,38 @@ def write_parquet(path: str, columns: dict, row_group_rows: int = 4096,
             else:
                 arrays.append(pa.array(col))
             names.append(name)
-        pq.write_table(pa.Table.from_arrays(arrays, names=names),
-                       os.path.join(path, f"part-{p:05d}.parquet"),
-                       row_group_size=row_group_rows)
+        part = f"{path.rstrip('/')}/part-{p:05d}.parquet" if fs is not None \
+            else os.path.join(path, f"part-{p:05d}.parquet")
+        table = pa.Table.from_arrays(arrays, names=names)
+        if fs is None:
+            pq.write_table(table, part, row_group_size=row_group_rows)
+        else:
+            with fs.open(part, "wb") as f:
+                pq.write_table(table, f, row_group_size=row_group_rows)
 
 
 def read_parquet_shard(path: str, columns: List[str], rank: int = 0,
-                       size: int = 1):
+                       size: int = 1, fs=None):
     """Read this worker's shard (rows ``rank::size``) of a Parquet dataset
     directory into numpy arrays, one per requested column."""
     import numpy as np
+    import pyarrow as pa
     import pyarrow.parquet as pq
 
-    files = sorted(
-        os.path.join(path, f) for f in os.listdir(path)
-        if f.endswith(".parquet"))
+    if fs is None:
+        files = sorted(
+            os.path.join(path, f) for f in os.listdir(path)
+            if f.endswith(".parquet"))
+        tables = [pq.read_table(f, columns=columns) for f in files]
+    else:
+        files = sorted(f for f in fs.ls(path, detail=False)
+                       if f.endswith(".parquet"))
+        tables = []
+        for f in files:
+            with fs.open(f, "rb") as fh:
+                tables.append(pq.read_table(fh, columns=columns))
     if not files:
         raise FileNotFoundError(f"no parquet files under {path}")
-    tables = [pq.read_table(f, columns=columns) for f in files]
-    import pyarrow as pa
     table = pa.concat_tables(tables)
     out = []
     for c in columns:

@@ -12,8 +12,7 @@ from typing import Callable, List, Optional
 
 import numpy as np
 
-from ..estimator import HorovodEstimator, HorovodModel
-from ..store import read_parquet_shard
+from ..estimator import HorovodEstimator, HorovodModel, load_split_shard
 
 
 def _serialize_torch(model) -> bytes:
@@ -51,7 +50,9 @@ class TorchEstimator(HorovodEstimator):
         label_cols = list(self.label_cols)
         batch_size, epochs = int(self.batch_size), int(self.epochs)
         shuffle, seed = bool(self.shuffle), int(self.random_seed)
-        validation = float(self.validation) if self.validation else 0.0
+        validation_spec = self._validation_spec()
+        sample_weight_col = self.sample_weight_col
+        fs = getattr(self._resolve_store(), "fs", None)
         # metrics: fn(outputs, targets) -> scalar, evaluated per epoch on
         # the held-out set (reference: TorchEstimator metrics,
         # spark/torch/estimator.py evaluation on the val DataLoader).
@@ -88,20 +89,48 @@ class TorchEstimator(HorovodEstimator):
                 opt = hvd_t.DistributedOptimizer(
                     opt, named_parameters=model.named_parameters())
 
-            cols = read_parquet_shard(
-                train_path, feature_cols + label_cols, rank, size)
-            x = _stack(cols[:len(feature_cols)]).astype(np.float32)
-            y = _stack(cols[len(feature_cols):]).astype(np.float32)
+            train, val, w_t, w_v = load_split_shard(
+                train_path, feature_cols, label_cols, rank, size,
+                sample_weight_col=sample_weight_col,
+                validation_spec=validation_spec, fs=fs)
+            x = _stack(train[:len(feature_cols)]).astype(np.float32)
+            y = _stack(train[len(feature_cols):]).astype(np.float32)
             xt, yt = torch.from_numpy(x), torch.from_numpy(y)
             if yt.ndim == 1:
                 yt = yt[:, None]
+            wt = torch.from_numpy(np.asarray(w_t, np.float32)) \
+                if w_t is not None else None
+            n_val = 0
+            if val is not None:
+                xv = torch.from_numpy(
+                    _stack(val[:len(feature_cols)]).astype(np.float32))
+                yv = torch.from_numpy(
+                    _stack(val[len(feature_cols):]).astype(np.float32))
+                if yv.ndim == 1:
+                    yv = yv[:, None]
+                n_val = len(xv)
 
-            # validation fraction held out of this worker's shard
-            # (reference: estimator `validation` param)
-            n_val = int(len(xt) * validation)
-            if n_val:
-                xv, yv = xt[-n_val:], yt[-n_val:]
-                xt, yt = xt[:-n_val], yt[:-n_val]
+            def batch_loss(pred, target, weights):
+                """Per-row weighting (reference `sample_weight_col`):
+                computed through the loss's reduction='none' form, then
+                weight-averaged so an all-ones column matches the
+                unweighted loss exactly."""
+                if weights is None:
+                    return loss_fn(pred, target)
+                if not hasattr(loss_fn, "reduction"):
+                    raise ValueError(
+                        "sample_weight_col requires a loss module with a "
+                        "`reduction` attribute (torch.nn losses); got "
+                        f"{type(loss_fn).__name__}")
+                prev = loss_fn.reduction
+                loss_fn.reduction = "none"
+                try:
+                    per = loss_fn(pred, target)
+                finally:
+                    loss_fn.reduction = prev
+                per = per.reshape(len(per), -1).mean(dim=1)
+                return (per * weights).sum() / weights.sum().clamp_min(
+                    torch.finfo(weights.dtype).tiny)
 
             g = torch.Generator().manual_seed(seed)
             n = len(xt)
@@ -115,7 +144,8 @@ class TorchEstimator(HorovodEstimator):
                 for s in range(0, n, batch_size):
                     idx = order[s:s + batch_size]
                     opt.zero_grad()
-                    loss = loss_fn(model(xt[idx]), yt[idx])
+                    loss = batch_loss(model(xt[idx]), yt[idx],
+                                      wt[idx] if wt is not None else None)
                     loss.backward()
                     opt.step()
                     epoch_loss += float(loss.detach()) * len(idx)

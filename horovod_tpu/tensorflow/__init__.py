@@ -333,16 +333,66 @@ class DistributedGradientTape:
         return getattr(self._tape, item)
 
 
+def _make_v1_distributed_optimizer(optimizer, op, name_prefix, compression,
+                                   prescale_factor, postscale_factor):
+    """TF1 graph-mode wrapper (reference: tensorflow/__init__.py:259-301
+    _DistributedOptimizer): subclasses ``tf.compat.v1.train.Optimizer`` and
+    overrides ``compute_gradients`` so legacy session scripts — including
+    ``minimize()`` and estimator trains — get reduced gradients. The
+    collective enters the graph through ``_reduce_gradients``' single
+    ``tf.py_function`` node (one submission point per step, fused), the
+    graph-mode analogue of the reference's HorovodAllreduceOp kernels."""
+    tf = _tf()
+
+    class _DistributedOptimizerV1(tf.compat.v1.train.Optimizer):
+        def __init__(self):
+            self._optimizer = optimizer
+            super().__init__(use_locking=False,
+                             name=name_prefix or "DistributedOptimizerV1")
+
+        def compute_gradients(self, *args, **kwargs):
+            gvs = self._optimizer.compute_gradients(*args, **kwargs)
+            reduced = _reduce_gradients(
+                [g for g, _ in gvs], op, name_prefix,
+                prescale_factor, postscale_factor, compression=compression)
+            return [(r, v) for r, (_, v) in zip(reduced, gvs)]
+
+        def apply_gradients(self, *args, **kwargs):
+            return self._optimizer.apply_gradients(*args, **kwargs)
+
+        def get_slot(self, *args, **kwargs):
+            return self._optimizer.get_slot(*args, **kwargs)
+
+        def get_slot_names(self, *args, **kwargs):
+            return self._optimizer.get_slot_names(*args, **kwargs)
+
+        def variables(self, *args, **kwargs):
+            return self._optimizer.variables(*args, **kwargs)
+
+    return _DistributedOptimizerV1()
+
+
 def DistributedOptimizer(optimizer, op=Average, name_prefix: str = "opt",
-                         compression=None):
-    """Wrap a keras/TF optimizer so ``apply_gradients`` reduces gradients
-    first (reference: tensorflow/__init__.py:259-301 _DistributedOptimizer
-    compute_gradients override; with Keras 3 the interception point is
-    apply_gradients). ``compression`` compresses the wire payloads."""
+                         compression=None, prescale_factor: float = 1.0,
+                         postscale_factor: float = 1.0):
+    """Wrap an optimizer so gradients are reduced before being applied.
+
+    A ``tf.compat.v1.train.Optimizer`` (legacy graph scripts) gets the
+    reference's subclassing treatment — ``compute_gradients`` reduces
+    (reference: tensorflow/__init__.py:259-301 _DistributedOptimizer). A
+    keras/TF2 optimizer is intercepted at ``apply_gradients`` (with Keras 3
+    that is the only stable hook). ``compression`` compresses the wire
+    payloads."""
+    tf = _tf()
+    if isinstance(optimizer, tf.compat.v1.train.Optimizer):
+        return _make_v1_distributed_optimizer(
+            optimizer, op, name_prefix, compression,
+            prescale_factor, postscale_factor)
 
     def apply_gradients(grads_and_vars, *args, **kwargs):
         gv = list(grads_and_vars)
         reduced = _reduce_gradients([g for g, _ in gv], op, name_prefix,
+                                    prescale_factor, postscale_factor,
                                     compression=compression)
         return type(optimizer).apply_gradients(
             optimizer, [(r, v) for r, (_, v) in zip(reduced, gv)],

@@ -126,11 +126,13 @@ def _run_scaling(n: int, quick: bool, timeout: int):
 
 def worker_eager(quick: bool) -> int:
     import horovod_tpu as hvd
-    from horovod_tpu.microbench import DEFAULT_SIZES, eager_sweep
+    from horovod_tpu.microbench import (
+        DEFAULT_SIZES, bucketed_optimizer_sweep, eager_sweep)
 
     hvd.init()
     sizes = DEFAULT_SIZES[:4] if quick else DEFAULT_SIZES
     rows = eager_sweep(sizes=sizes, iters=3 if quick else 5)
+    rows.append(bucketed_optimizer_sweep(iters=2 if quick else 3))
     if hvd.rank() == 0:
         for r in rows:
             print(MB_TAG + json.dumps(r))
@@ -163,11 +165,20 @@ def main():
     t0 = time.time()
     result = {"quick": quick}
 
+    def split_bucketed(rows):
+        if not rows:
+            return rows, None
+        plain = [r for r in rows if "scenario" not in r]
+        bk = next((r for r in rows if "scenario" in r), None)
+        return plain, bk
+
     _log("section 1/3: eager sweep, 1 process")
-    result["eager_1proc"] = _run_eager(1, quick, timeout=600)
+    result["eager_1proc"], result["bucketed_1proc"] = split_bucketed(
+        _run_eager(1, quick, timeout=600))
 
     _log("section 2/3: eager sweep, 2 processes")
-    result["eager_2proc"] = _run_eager(2, quick, timeout=900)
+    result["eager_2proc"], result["bucketed_2proc"] = split_bucketed(
+        _run_eager(2, quick, timeout=900))
 
     _log("section 3/3: compiled-plane scaling sweep")
     points = []
@@ -193,6 +204,7 @@ def main():
     # one-line summary for the driver log
     two = result.get("eager_2proc") or []
     big = two[-1] if two else None
+    bk2 = result.get("bucketed_2proc") or result.get("bucketed_1proc")
     print(json.dumps({
         "metric": "collective_microbench",
         "eager_2proc_peak_bytes_per_s": round(big["eager_bytes_per_s"])
@@ -201,6 +213,7 @@ def main():
         if big else None,
         "dispatch_latency_us": round(
             min(r["dispatch_latency_s"] for r in two) * 1e6) if two else None,
+        "bucketed_speedup": bk2.get("bucketed_speedup") if bk2 else None,
         "scaling_points": len(result["scaling"]),
     }))
     return 0

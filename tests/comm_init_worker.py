@@ -1,0 +1,76 @@
+"""Worker for the init(comm=...) integration test.
+
+Simulates the mpi4py surface with a file-backed communicator: rank/size
+from argv, ``bcast`` through a file rank 0 writes and peers poll. Proves
+the comm-driven rendezvous path (identity + coordinator address both from
+the communicator, NO launcher env contract) initializes a real
+multi-process world — the reference's ``hvd.init(comm=...)`` semantics
+(common/basics.py:33-65) without requiring MPI in the image.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# the point of this worker: no HVD_TPU_* env contract at all
+for k in list(os.environ):
+    if k.startswith(("HVD_TPU_", "HOROVOD_")):
+        del os.environ[k]
+
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+
+class FileComm:
+    """mpi4py-shaped communicator over a shared scratch dir."""
+
+    def __init__(self, rank: int, size: int, scratch: str):
+        self._rank, self._size, self._scratch = rank, size, scratch
+
+    def Get_rank(self) -> int:
+        return self._rank
+
+    def Get_size(self) -> int:
+        return self._size
+
+    def bcast(self, obj, root: int = 0):
+        import pickle
+        path = os.path.join(self._scratch, f"bcast-{root}")
+        if self._rank == root:
+            with open(path + ".tmp", "wb") as f:
+                pickle.dump(obj, f)
+            os.replace(path + ".tmp", path)
+            return obj
+        deadline = time.time() + 60
+        while not os.path.exists(path):
+            if time.time() > deadline:
+                raise TimeoutError("bcast root never published")
+            time.sleep(0.01)
+        with open(path, "rb") as f:
+            return pickle.load(f)
+
+
+def main() -> int:
+    rank, size, scratch = int(sys.argv[1]), int(sys.argv[2]), sys.argv[3]
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import horovod_tpu as hvd
+
+    hvd.init(comm=FileComm(rank, size, scratch))
+    assert hvd.rank() == rank, (hvd.rank(), rank)
+    assert hvd.size() == size, (hvd.size(), size)
+    out = np.asarray(hvd.allreduce(
+        np.full(3, float(rank + 1), np.float32), op=hvd.Sum, name="ci"))
+    expected = sum(range(1, size + 1))
+    np.testing.assert_allclose(out, np.full(3, float(expected)))
+    print(f"comm init worker {rank} OK", flush=True)
+    hvd.shutdown()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -117,7 +117,10 @@ def test_mpi_env_rank_detection():
                       "OMPI_COMM_WORLD_LOCAL_RANK": "1",
                       "OMPI_COMM_WORLD_LOCAL_SIZE": "4"})
     assert ident == {"RANK": 3, "SIZE": 8, "LOCAL_RANK": 1,
-                     "LOCAL_SIZE": 4}
+                     "LOCAL_SIZE": 4,
+                     # derived for uniform hosts (round 5): host index
+                     # and host count from rank//local_size
+                     "CROSS_RANK": 0, "CROSS_SIZE": 2}
 
     # PMIx rank WITHOUT a size variable: no identity (silent
     # single-process degradation would mean wrong gradients)
@@ -135,7 +138,7 @@ def test_mpi_env_rank_detection():
                       "SLURM_LOCALID": "1",
                       "SLURM_STEP_TASKS_PER_NODE": "4(x2)"})
     assert ident == {"RANK": 5, "SIZE": 8, "LOCAL_RANK": 1,
-                     "LOCAL_SIZE": 4}
+                     "LOCAL_SIZE": 4, "CROSS_RANK": 1, "CROSS_SIZE": 2}
 
     # Config.get precedence: HVD_TPU_ > HOROVOD_ > family detection
     import unittest.mock as mock

@@ -92,6 +92,72 @@ def test_grouped_allreduce(hvd_world):
         np.testing.assert_array_equal(np.asarray(o), xs[i])
 
 
+def test_grouped_allreduce_hybrid_packing_paths(hvd_world):
+    """The fused dispatch routes members three ways (host-packed per
+    dtype, large-separate, device-resident-separate); results must come
+    back in input order regardless of route. Covers the round-5 hybrid
+    fusion buffer: members over HVD_TPU_PACK_CUTOFF bytes stage
+    separately, the rest pack per dtype."""
+    import jax.numpy as jnp
+    from horovod_tpu import config as _config
+    from horovod_tpu.basics import world
+    assert world().config.get(_config.PACK_CUTOFF) == 256 * 1024
+    big = np.full((80000,), 2.0, np.float32)      # 320KB > cutoff
+    xs = [
+        np.full((7,), 1.0, np.float32),           # packed (f32 group)
+        big,                                      # separate: too large
+        np.arange(4, dtype=np.int32),             # packed (i32 group)
+        jnp.full((3,), 5.0, jnp.float32),         # separate: on device
+        np.full((2, 2), 3.0, np.float32),         # packed (f32 group)
+        np.float32(4.0).reshape(()),              # packed scalar
+    ]
+    outs = hvd.grouped_allreduce(xs, op=hvd.Sum, name="hybrid")
+    assert len(outs) == len(xs)
+    for x, o in zip(xs, outs):
+        np.testing.assert_array_equal(np.asarray(o), np.asarray(x))
+        assert np.asarray(o).dtype == np.asarray(x).dtype
+        assert np.asarray(o).shape == np.asarray(x).shape
+
+
+def test_grouped_program_cache_does_not_pin_inputs(hvd_world):
+    """The cached jit programs must capture only the plan, never the
+    first call's tensors — a 97 MB gradient list pinned per cache entry
+    for the process lifetime is a leak (round-5 review finding)."""
+    import gc
+    import weakref
+    big = np.ones(80000, np.float32)      # separate route (> cutoff)
+    small = np.ones(7, np.float32)        # packed route
+    refs = [weakref.ref(big), weakref.ref(small)]
+    hvd.grouped_allreduce([small, big], op=hvd.Sum, name="pin1")
+    # second call through the now-cached program with fresh values
+    hvd.grouped_allreduce([np.ones(7, np.float32),
+                           np.ones(80000, np.float32)],
+                          op=hvd.Sum, name="pin2")
+    del big, small
+    gc.collect()
+    assert all(r() is None for r in refs), \
+        "cached collective program retains first-call tensors"
+
+
+def test_grouped_allreduce_pack_cutoff_zero_disables(hvd_world,
+                                                     monkeypatch):
+    monkeypatch.setenv("HVD_TPU_PACK_CUTOFF", "0")
+    xs = [np.full((5,), float(i + 1), np.float32) for i in range(3)]
+    outs = hvd.grouped_allreduce(xs, op=hvd.Sum, name="nopack")
+    for x, o in zip(xs, outs):
+        np.testing.assert_array_equal(np.asarray(o), x)
+
+
+def test_grouped_allreduce_average_and_scales_across_routes(hvd_world):
+    """Scales apply per member on both the packed and separate routes."""
+    big = np.full((80000,), 4.0, np.float32)
+    xs = [np.full((3,), 4.0, np.float32), big]
+    outs = hvd.grouped_allreduce(xs, op=hvd.Average, prescale_factor=0.5)
+    np.testing.assert_allclose(np.asarray(outs[0]), np.full((3,), 2.0))
+    np.testing.assert_allclose(np.asarray(outs[1]),
+                               np.full((80000,), 2.0))
+
+
 def test_allgather_size1(hvd_world):
     x = np.arange(6, dtype=np.float32).reshape(2, 3)
     out = hvd.allgather(x)

@@ -112,8 +112,28 @@ def test_elastic_fault_tolerance_rank_failure():
         assert int(m.group(1)) == 1
         # epochs 2..4 ran in the shrunken generation => committed state
         # (epoch counter) survived the re-exec reset
-        later = [e for e in events if re.match(r"epoch=[234] rank=0 size=1", e)]
+        later = [e for e in events if re.match(r"epoch=[234] rank=0 size=1 ",
+                                               e)]
         assert len(later) >= 3, events
+        # --- recovery latency (VERDICT r4 item 9): seconds from the kill
+        # to the survivor's first completed epoch in the new generation.
+        # This spans failure detection, driver reset, worker re-exec,
+        # jax.distributed re-init, and state restore. The bound is
+        # deliberately generous (shared CI box); the measured number is
+        # printed and published in docs/elastic.md.
+        def _t(event):
+            m = re.search(r" t=([0-9.]+)$", event)
+            assert m, event
+            return float(m.group(1))
+
+        kill_t = _t(next(e for e in events
+                         if e.startswith("killed rank=1 epoch=1")))
+        post = [_t(e) for e in later if _t(e) > kill_t]
+        assert post, events
+        recovery_s = min(post) - kill_t
+        print(f"elastic recovery: kill -> first post-reset epoch = "
+              f"{recovery_s:.2f}s")
+        assert recovery_s < 60.0, recovery_s
 
 
 @pytest.mark.integration

@@ -238,6 +238,44 @@ class TestReviewFixes:
         assert ident["RANK"] == 3 and ident["SIZE"] == 4
         assert ident["LOCAL_RANK"] == 1 and ident["LOCAL_SIZE"] == 2
 
+    def test_cross_identity_derived_for_uniform_hosts(self):
+        """MPI launchers export no cross-host identity; with uniform
+        slots it is derivable from rank//local_size — without this,
+        --mpi workers on multi-slot hosts get cross_rank==rank (wrong
+        hierarchical grouping)."""
+        from horovod_tpu.config import mpi_task_identity
+        env = {"OMPI_COMM_WORLD_RANK": "3", "OMPI_COMM_WORLD_SIZE": "4",
+               "OMPI_COMM_WORLD_LOCAL_RANK": "1",
+               "OMPI_COMM_WORLD_LOCAL_SIZE": "2"}
+        ident = mpi_task_identity(env)
+        assert ident["CROSS_RANK"] == 1 and ident["CROSS_SIZE"] == 2
+        # non-uniform (size not divisible): no guess
+        env["OMPI_COMM_WORLD_SIZE"] = "5"
+        ident = mpi_task_identity(env)
+        assert "CROSS_RANK" not in ident
+
+    def test_cross_identity_reaches_basics(self, monkeypatch):
+        """End to end through Config.get: a worker env as mpirun sets it
+        resolves the full GLOBAL/LOCAL/CROSS triple."""
+        import horovod_tpu as hvd
+        for k, v in (("OMPI_COMM_WORLD_RANK", "0"),
+                     ("OMPI_COMM_WORLD_SIZE", "1"),
+                     ("OMPI_COMM_WORLD_LOCAL_RANK", "0"),
+                     ("OMPI_COMM_WORLD_LOCAL_SIZE", "1")):
+            monkeypatch.setenv(k, v)
+        for k in ("HVD_TPU_RANK", "HVD_TPU_SIZE", "HVD_TPU_LOCAL_RANK",
+                  "HVD_TPU_LOCAL_SIZE", "HVD_TPU_CROSS_RANK",
+                  "HVD_TPU_CROSS_SIZE"):
+            monkeypatch.delenv(k, raising=False)
+        if hvd.is_initialized():
+            hvd.shutdown()
+        hvd.init()
+        try:
+            assert hvd.cross_rank() == 0 and hvd.cross_size() == 1
+            assert hvd.local_rank() == 0 and hvd.local_size() == 1
+        finally:
+            hvd.shutdown()
+
     def test_np_overrides_stale_size_env(self):
         captured = {}
         mpi_run(basic_settings(num_proc=4),
@@ -360,13 +398,24 @@ class TestRunController:
                 lambda: log.append("js") or 0,
                 lambda: log.append("local") or 0)
 
-    def test_explicit_local_wins(self):
+    def test_explicit_local_alone_wins(self):
         log = []
         mpi_fn, js_fn, local_fn = self._fns(log)
         rc = launch_mod.run_controller(
-            use_mpi=True, mpi_fn=mpi_fn, use_jsrun=False, js_fn=js_fn,
+            use_mpi=False, mpi_fn=mpi_fn, use_jsrun=False, js_fn=js_fn,
             use_local=True, local_fn=local_fn)
         assert rc == 0 and log == ["local"]
+
+    def test_contradictory_backends_rejected(self):
+        """--gloo with --mpi must error, not silently drop one
+        (reference horovodrun rejects the combination)."""
+        log = []
+        mpi_fn, js_fn, local_fn = self._fns(log)
+        with pytest.raises(RuntimeError, match="contradictory"):
+            launch_mod.run_controller(
+                use_mpi=True, mpi_fn=mpi_fn, use_jsrun=False, js_fn=js_fn,
+                use_local=True, local_fn=local_fn)
+        assert log == []
 
     def test_explicit_mpi(self, monkeypatch):
         import horovod_tpu.runner.mpi_run as mr

@@ -70,7 +70,7 @@ JOIN_WORKER = os.path.join(os.path.dirname(__file__), "join_worker.py")
 
 
 @pytest.mark.integration
-@pytest.mark.parametrize("n", [2, 3])
+@pytest.mark.parametrize("n", [2, 3, 4])
 def test_multiprocess_join_uneven_data(n):
     """Uneven batch counts + join() (reference: test_torch.py join tests,
     operations.cc:942-966). Rank r trains 2+r batches; early finishers
@@ -147,6 +147,59 @@ def test_torch_adasum_delta_optimizer_numerics():
     for i, (c, o) in enumerate(zip(codes, outs)):
         assert c == 0, f"worker {i} failed:\n{o[-4000:]}"
         assert f"adasum torch worker {i} OK" in o
+
+
+MULTIHOST_WORKER = os.path.join(os.path.dirname(__file__),
+                                "multihost_worker.py")
+
+
+@pytest.mark.integration
+def test_simulated_two_host_topology():
+    """2-host x 2-slot simulation over 4 real processes (VERDICT r4 item 4):
+    the launcher's slot-assignment math feeds each worker its identity env
+    (reference hosts.py:106-155), workers assert the GLOBAL/LOCAL/CROSS
+    triple and run hierarchical allreduce over a real (node, slot) mesh."""
+    from horovod_tpu.runner.hosts import HostInfo, get_host_assignments
+
+    slots, size = get_host_assignments(
+        [HostInfo("hostA", 2), HostInfo("hostB", 2)], 4)
+    assert size == 4
+    port = _free_port()
+    procs = []
+    for s in slots:
+        env = dict(os.environ)
+        env.pop("XLA_FLAGS", None)
+        env.pop("PALLAS_AXON_POOL_IPS", None)
+        repo_root = os.path.dirname(os.path.dirname(
+            os.path.abspath(MULTIHOST_WORKER)))
+        env.update({
+            "PYTHONPATH": repo_root + os.pathsep + env.get("PYTHONPATH", ""),
+            "JAX_PLATFORMS": "cpu",
+            "HVD_TPU_COORDINATOR_ADDR": f"127.0.0.1:{port}",
+            "HVD_TPU_SIZE": str(s.size),
+            "HVD_TPU_RANK": str(s.rank),
+            "HVD_TPU_LOCAL_RANK": str(s.local_rank),
+            "HVD_TPU_LOCAL_SIZE": str(s.local_size),
+            "HVD_TPU_CROSS_RANK": str(s.cross_rank),
+            "HVD_TPU_CROSS_SIZE": str(s.cross_size),
+        })
+        procs.append(subprocess.Popen(
+            [sys.executable, MULTIHOST_WORKER], env=env,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT))
+    outs, codes = [], []
+    for p in procs:
+        try:
+            out, _ = p.communicate(timeout=240)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            raise
+        outs.append(out.decode(errors="replace"))
+        codes.append(p.returncode)
+    for i, (c, o) in enumerate(zip(codes, outs)):
+        assert c == 0, f"worker {i} failed (exit {c}):\n{o[-4000:]}"
+        assert f"multihost worker {i} OK" in o
+        assert f"local {i % 2}/2 cross {i // 2}/2" in o
 
 
 @pytest.mark.integration

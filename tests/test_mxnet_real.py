@@ -1,0 +1,130 @@
+"""Real-MXNet bridge tests (VERDICT r4 item 6).
+
+`tests/test_mxnet_stub.py` validates repo-side logic against a stub
+NDArray surface; THIS module runs the same bridge against the actual
+MXNet engine (reference: /root/reference/horovod/mxnet/mpi_ops.cc:309
+pushes collectives through the real engine with var deps, and
+/root/reference/test/test_mxnet.py is the upstream suite shape). MXNet is
+end-of-life upstream and not baked into this image, so the module
+self-skips when it cannot import — run `pip install mxnet` on an
+environment that allows it to activate these tests; they are written
+against the public gluon/ndarray API only.
+"""
+
+import numpy as np
+import pytest
+
+mx = pytest.importorskip("mxnet")
+
+import horovod_tpu as hvd  # noqa: E402
+import horovod_tpu.mxnet as hvd_mx  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _world():
+    hvd.init()
+    yield
+
+
+class TestCollectives:
+    """Size-1 exact numerics through the real NDArray engine (the
+    reference's single-worker test mode)."""
+
+    def test_allreduce_average_and_sum(self):
+        x = mx.nd.array(np.arange(6, dtype=np.float32).reshape(2, 3))
+        out = hvd_mx.allreduce(x, average=True, name="mxr.ar")
+        assert isinstance(out, mx.nd.NDArray)
+        np.testing.assert_allclose(out.asnumpy(), x.asnumpy())
+        out = hvd_mx.allreduce(x, average=False, name="mxr.ars")
+        np.testing.assert_allclose(out.asnumpy(), x.asnumpy())
+
+    def test_grouped_allreduce(self):
+        xs = [mx.nd.array(np.full((4,), float(i), np.float32))
+              for i in range(3)]
+        outs = hvd_mx.grouped_allreduce(xs, average=False, name="mxr.gar")
+        assert len(outs) == 3
+        for x, o in zip(xs, outs):
+            assert isinstance(o, mx.nd.NDArray)
+            np.testing.assert_allclose(o.asnumpy(), x.asnumpy())
+
+    def test_allgather_broadcast_alltoall(self):
+        x = mx.nd.array(np.arange(4, dtype=np.float32))
+        np.testing.assert_allclose(
+            hvd_mx.allgather(x, name="mxr.ag").asnumpy(), x.asnumpy())
+        np.testing.assert_allclose(
+            hvd_mx.broadcast(x, root_rank=0, name="mxr.bc").asnumpy(),
+            x.asnumpy())
+        np.testing.assert_allclose(
+            hvd_mx.alltoall(x, name="mxr.a2a").asnumpy(), x.asnumpy())
+
+    def test_dtype_preserved(self):
+        for dt in (np.float32, np.float64, np.int32):
+            x = mx.nd.array(np.ones(3), dtype=dt)
+            out = hvd_mx.allreduce(x, average=False, name=f"mxr.dt.{dt}")
+            assert out.dtype == np.dtype(dt)
+
+    def test_broadcast_object(self):
+        obj = {"lr": 0.1, "sched": [1, 2, 3]}
+        assert hvd_mx.broadcast_object(obj, name="mxr.obj") == obj
+
+
+class TestGluonIntegration:
+    def _toy_net(self):
+        net = mx.gluon.nn.Sequential()
+        net.add(mx.gluon.nn.Dense(8, activation="relu"))
+        net.add(mx.gluon.nn.Dense(1))
+        net.initialize(mx.init.Xavier(), force_reinit=True)
+        return net
+
+    def test_broadcast_parameters_real_params(self):
+        net = self._toy_net()
+        net(mx.nd.zeros((2, 4)))  # materialize shapes
+        params = net.collect_params()
+        before = {k: v.data().asnumpy().copy() for k, v in params.items()}
+        hvd_mx.broadcast_parameters(params, root_rank=0)
+        # size-1 broadcast is identity but must run through the engine
+        # and write back in place
+        for k, v in params.items():
+            np.testing.assert_allclose(v.data().asnumpy(), before[k])
+
+    def test_distributed_trainer_trains(self):
+        """The canonical reference recipe (examples/mxnet_mnist.py):
+        broadcast, DistributedTrainer, autograd steps — loss must drop on
+        a toy regression through the REAL engine."""
+        net = self._toy_net()
+        net(mx.nd.zeros((2, 4)))
+        hvd_mx.broadcast_parameters(net.collect_params(), root_rank=0)
+        trainer = hvd_mx.DistributedTrainer(
+            net.collect_params(), "sgd", {"learning_rate": 0.05})
+        loss_fn = mx.gluon.loss.L2Loss()
+        rng = np.random.RandomState(0)
+        x = mx.nd.array(rng.randn(64, 4).astype(np.float32))
+        w = mx.nd.array([[1.0], [-2.0], [0.5], [2.0]])
+        y = mx.nd.dot(x, w)
+        losses = []
+        for _ in range(40):
+            with mx.autograd.record():
+                loss = loss_fn(net(x), y).mean()
+            loss.backward()
+            trainer.step(batch_size=64)
+            losses.append(float(loss.asscalar()))
+        assert losses[-1] < 0.5 * losses[0], losses
+
+    def test_distributed_optimizer_update(self):
+        opt = hvd_mx.DistributedOptimizer(
+            mx.optimizer.SGD(learning_rate=0.1))
+        weight = mx.nd.ones((4,))
+        grad = mx.nd.ones((4,))
+        state = opt.create_state(0, weight)
+        opt.update(0, weight, grad, state)
+        # sgd step: w -= lr * (grad averaged across 1 process)
+        np.testing.assert_allclose(weight.asnumpy(),
+                                   np.full((4,), 0.9), rtol=1e-5)
+
+    def test_trainer_rejects_wrapped_optimizer(self):
+        net = self._toy_net()
+        net(mx.nd.zeros((2, 4)))
+        opt = hvd_mx.DistributedOptimizer(
+            mx.optimizer.SGD(learning_rate=0.1))
+        with pytest.raises(ValueError, match="plain optimizer"):
+            hvd_mx.DistributedTrainer(net.collect_params(), opt)

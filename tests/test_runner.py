@@ -361,7 +361,8 @@ class TestLSF:
                "JSM_NAMESPACE_LOCAL_SIZE": "4"}
         out = jsrun_rank_env(env)
         assert out == {"HVD_TPU_RANK": "3", "HVD_TPU_SIZE": "8",
-                       "HVD_TPU_LOCAL_RANK": "1", "HVD_TPU_LOCAL_SIZE": "4"}
+                       "HVD_TPU_LOCAL_RANK": "1", "HVD_TPU_LOCAL_SIZE": "4",
+                       "HVD_TPU_CROSS_RANK": "0", "HVD_TPU_CROSS_SIZE": "2"}
         # OMPI fallbacks
         out = jsrun_rank_env({"OMPI_COMM_WORLD_RANK": "0",
                               "OMPI_COMM_WORLD_SIZE": "2"})
