@@ -1,0 +1,115 @@
+#!/usr/bin/env python
+"""Generate the CI pipeline from the docker-compose test matrix.
+
+Reference: /root/reference/.buildkite/gen-pipeline.sh builds a Buildkite
+YAML with one build step + a fan of test steps per compose service, and
+/root/reference/test/test_buildkite.py pins the generated output.
+Here the generator is Python (deterministic, unit-testable) and the
+test-step fan reflects THIS suite's structure: unit, multi-process
+integration, elastic e2e, and per-launcher extras.
+
+Usage: ``python ci/gen_pipeline.py > pipeline.yml`` (plain YAML, no
+external deps — the emitter writes the subset of YAML it needs).
+"""
+
+import os
+import re
+import sys
+from typing import Dict, List
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+COMPOSE_PATH = os.path.join(HERE, "docker-compose.test.yml")
+
+#: suites every service runs (path, parallelism-safe, timeout minutes)
+COMMON_SUITES = [
+    ("unit", "python -m pytest tests/ -q -m 'not integration'", 30),
+    ("multiproc",
+     "python -m pytest tests/test_multiprocess_integration.py -q", 30),
+    ("elastic", "python -m pytest tests/test_elastic_e2e.py -q", 40),
+]
+
+#: extra suites keyed by a substring of the service name
+EXTRA_SUITES = {
+    "openmpi": [("mpirun-launch-openmpi",
+                 "python -m pytest tests/test_mpi_run.py "
+                 "tests/test_comm_init.py -q", 20)],
+    "mpich": [("mpirun-launch-mpich",
+               "python -m pytest tests/test_mpi_run.py -q", 20)],
+    "mxnet": [("mxnet-real",
+               "python -m pytest tests/test_mxnet_real.py -q", 20)],
+}
+
+
+def parse_compose_services(path: str = COMPOSE_PATH) -> List[str]:
+    """Service names from the compose file, base excluded. A tiny
+    structural parse (two-space indented keys under ``services:``) keeps
+    the generator dependency-free; the shape test pins it against the
+    real file so drift fails loudly."""
+    services = []
+    in_services = False
+    for line in open(path):
+        if re.match(r"^services:\s*$", line):
+            in_services = True
+            continue
+        if in_services and re.match(r"^\S", line):
+            break
+        m = re.match(r"^  ([A-Za-z0-9_-]+):\s*$", line)
+        if in_services and m:
+            services.append(m.group(1))
+    return [s for s in services if s != "test-cpu-base"]
+
+
+def build_pipeline(services: List[str]) -> List[Dict]:
+    steps: List[Dict] = []
+    for svc in services:
+        steps.append({
+            "label": f":docker: build {svc}",
+            "command": (f"docker compose -f ci/docker-compose.test.yml "
+                        f"build {svc}"),
+            "key": f"build-{svc}",
+            "timeout_in_minutes": 40,
+        })
+    steps.append({"wait": None})
+    for svc in services:
+        suites = list(COMMON_SUITES)
+        for needle, extra in EXTRA_SUITES.items():
+            if needle in svc:
+                suites += extra
+        for name, cmd, timeout in suites:
+            steps.append({
+                "label": f":pytest: {name} [{svc}]",
+                "command": (f"docker compose -f ci/docker-compose.test.yml "
+                            f"run --rm {svc} {cmd}"),
+                "depends_on": f"build-{svc}",
+                "timeout_in_minutes": timeout,
+            })
+    return steps
+
+
+def emit_yaml(steps: List[Dict]) -> str:
+    lines = ["steps:"]
+    for s in steps:
+        if list(s.keys()) == ["wait"]:
+            lines.append("- wait")
+            continue
+        first = True
+        for k in ("label", "command", "key", "depends_on",
+                  "timeout_in_minutes"):
+            if k not in s:
+                continue
+            v = s[k]
+            prefix = "- " if first else "  "
+            first = False
+            if isinstance(v, str):
+                v = '"' + v.replace('"', '\\"') + '"'
+            lines.append(f"{prefix}{k}: {v}")
+    return "\n".join(lines) + "\n"
+
+
+def main() -> int:
+    sys.stdout.write(emit_yaml(build_pipeline(parse_compose_services())))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -113,10 +113,37 @@ def _identity_from_comm(comm, coordinator_address):
             with socket.socket() as s:
                 s.bind(("0.0.0.0", 0))
                 port = s.getsockname()[1]
-            host = socket.gethostname()
-            addr = f"{host}:{port}"
+            addr = f"{_routable_host()}:{port}"
         coordinator_address = comm.bcast(addr, root=0)
     return coordinator_address, size, rank
+
+
+def _routable_host() -> str:
+    """A host identity peers can actually dial. ``gethostname()`` alone is
+    a trap on stock Debian/Ubuntu, where /etc/hosts maps the hostname to
+    127.0.1.1 — remote ranks would connect to themselves and hang in
+    jax.distributed init. Prefer the default-route interface IP (UDP
+    connect performs no traffic); keep the hostname when it already
+    resolves to a routable address (reference: the driver/task services
+    resolve a usable NIC the same spirit, runner/driver_service.py)."""
+    import socket
+
+    host = socket.gethostname()
+    try:
+        resolved = socket.gethostbyname(host)
+    except OSError:
+        resolved = "127.0.0.1"
+    if not resolved.startswith("127."):
+        return host
+    try:
+        with socket.socket(socket.AF_INET, socket.SOCK_DGRAM) as s:
+            s.connect(("8.8.8.8", 53))
+            ip = s.getsockname()[0]
+        if ip and not ip.startswith("127."):
+            return ip
+    except OSError:
+        pass
+    return host
 
 
 def init(process_sets: Optional[Sequence[Sequence[int]]] = None,

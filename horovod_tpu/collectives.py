@@ -130,6 +130,18 @@ def _dtype_str(dt) -> str:
     return s
 
 
+def _zeros_like_staged(v):
+    """Zero contribution that PRESERVES staging residency. The grouped
+    dispatch routes members by host/device residency (hybrid fusion
+    buffer), so a joined rank substituting host zeros for device-resident
+    gradients would compile a different SPMD program than its active
+    peers — a deadlock, not an error. Device members get device zeros."""
+    jax = _jax()
+    if isinstance(v, jax.Array):
+        return _jnp().zeros(v.shape, v.dtype)
+    return np.zeros(v.shape, v.dtype)
+
+
 def _stage_input(t):
     """Coerce a collective input for staging WITHOUT forcing device data
     through the host: a fully-addressable jax array is used as-is
@@ -409,7 +421,7 @@ def _allreduce_impl(w, values, op, prescale_factor, postscale_factor,
         # After join(), this process contributes zeros to every further
         # reduction (reference: GetTensorEntriesFromResponse substitutes zero
         # tensors for joined ranks, tensor_queue.cc).
-        values = [np.zeros(v.shape, v.dtype) for v in values]
+        values = [_zeros_like_staged(v) for v in values]
 
     if op == ReduceOp.ADASUM:
         from .adasum import adasum_eager
@@ -610,7 +622,7 @@ def allreduce_async(tensor, average=None, name: Optional[str] = None,
         _check_consistency(w, wm, name, local.shape, local.dtype,
                            "allreduce", op.value)
         tl.activity_start(name, _tl.XLA_ALLREDUCE)
-        vals = [np.zeros(local.shape, local.dtype)] \
+        vals = [_zeros_like_staged(local)] \
             if joined_at_submit else [local]
         (out,) = _allreduce_impl(w, vals, op, prescale_factor,
                                  postscale_factor, process_set, internal=True)
@@ -681,12 +693,21 @@ def grouped_allreduce_async(tensors: Sequence, average=None,
 
     def dispatch():
         # Wire-format shapes are flat dim lists; fingerprint the group's
-        # full member metadata through the free-form ``extra`` lane.
+        # full member metadata through the free-form ``extra`` lane —
+        # including each member's staging residency and this process's
+        # pack cutoff, because the hybrid fusion buffer routes by them:
+        # peers whose routing diverges (e.g. one rank feeds numpy where
+        # another feeds jax arrays) would compile different SPMD programs,
+        # which must surface as a validation error, not a deadlock.
+        routing = tuple(
+            isinstance(l, _jax().Array) for l in locals_)
+        cutoff = w.config.get(_config.PACK_CUTOFF)
         _check_consistency(w, wm, base, (len(locals_),), "grouped",
                            "grouped_allreduce",
-                           extra=lambda: f"{shapes}|{dtypes}|{op.value}")
+                           extra=lambda: f"{shapes}|{dtypes}|{op.value}"
+                                         f"|{routing}|{cutoff}")
         tl.activity_start(base, _tl.XLA_ALLREDUCE)
-        vals = [np.zeros(l.shape, l.dtype) for l in locals_] \
+        vals = [_zeros_like_staged(l) for l in locals_] \
             if joined_at_submit else locals_
         outs = _allreduce_impl(w, vals, op, prescale_factor,
                                postscale_factor, process_set, internal=True)

@@ -14,6 +14,7 @@ existing run scripts keep working.
 
 import dataclasses
 import os
+import re
 from typing import Any, Callable, Dict, Optional
 
 
@@ -167,11 +168,17 @@ def mpi_task_identity(environ=None, with_source: bool = False):
         # rank placement and uniform slots (mpirun's default map-by slot
         # over -H h:n lists, and ppr mappings) the cross triple is
         # derivable: the host index and host count. Non-uniform layouts
-        # (size % local_size != 0) stay unset rather than guessed —
-        # basics falls back to its defaults there (reference: cross comm
-        # from MPI_Comm_split by local_rank, mpi_context.cc:147-156).
+        # stay unset rather than guessed — basics falls back to its
+        # defaults there (reference: cross comm from MPI_Comm_split by
+        # local_rank, mpi_context.cc:147-156). Heterogeneity shows up two
+        # ways: size % local_size != 0, or a SLURM per-node list whose
+        # parse() truncation would hide it ("2,4" -> 2), so any local
+        # size value beyond the single "N" / uniform "N(xM)" forms also
+        # disqualifies the derivation.
         ls = out.get("LOCAL_SIZE")
-        if ls and ls > 0 and out["SIZE"] % ls == 0:
+        raw_ls = env.get(lsize_var, "")
+        uniform_form = re.fullmatch(r"\d+(\(x\d+\))?", str(raw_ls).strip())
+        if ls and ls > 0 and uniform_form and out["SIZE"] % ls == 0:
             out.setdefault("CROSS_RANK", out["RANK"] // ls)
             out.setdefault("CROSS_SIZE", out["SIZE"] // ls)
         return (out, rank_var) if with_source else out

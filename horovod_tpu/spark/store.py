@@ -52,11 +52,18 @@ class Store:
             scheme = prefix_path.split("://", 1)[0]
             try:
                 import fsspec
-                fsspec.get_filesystem_class(scheme)
             except ImportError:
                 raise ValueError(
                     f"no store backend for scheme {scheme!r}: fsspec is "
                     f"not installed; use a local path (LocalStore)")
+            try:
+                fsspec.get_filesystem_class(scheme)
+            except ImportError as e:
+                # fsspec itself is present; the SCHEME's backend package
+                # (s3fs, gcsfs, ...) is what's missing — say so
+                raise ValueError(
+                    f"store scheme {scheme!r} needs an fsspec backend "
+                    f"package: {e}")
             except ValueError as e:
                 raise ValueError(
                     f"no store backend for scheme {scheme!r}: {e}")

@@ -202,6 +202,7 @@ class Timeline:
         if self._closed:
             return
         self._closed = True
+        writer_done = True
         if self._h is not None:
             with self._native_lock:
                 h, self._h = self._h, None
@@ -209,6 +210,17 @@ class Timeline:
         else:
             self._q.put(None)
             self._thread.join(timeout=10)
+            writer_done = not self._thread.is_alive()
+        if not writer_done:
+            # a wedged/backlogged writer still owns the file handle;
+            # splicing would interleave two writers into an unparseable
+            # trace — keep the host-only file intact instead
+            import logging
+            logging.getLogger("horovod_tpu").warning(
+                "timeline: writer thread still draining at close; "
+                "skipping device-trace splice to avoid corrupting %s",
+                self._path)
+            return
         # Device-trace splice happens at the FILE level after the writer
         # finishes: profiler events carry past timestamps that neither
         # writer's stamp-now emit path can represent.

@@ -1,0 +1,88 @@
+"""CI pipeline generation tests (reference:
+/root/reference/test/test_buildkite.py validates gen-pipeline.sh output
+against the compose matrix)."""
+
+import os
+import re
+import subprocess
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "ci"))
+
+from gen_pipeline import (  # noqa: E402
+    COMMON_SUITES, EXTRA_SUITES, build_pipeline, emit_yaml,
+    parse_compose_services)
+
+
+def test_compose_services_parsed():
+    svcs = parse_compose_services()
+    assert "test-cpu-base" not in svcs
+    assert "test-cpu-jaxonly-py3_12" in svcs
+    assert "test-cpu-openmpi-py3_12" in svcs
+    assert "test-cpu-mpich-py3_12" in svcs
+    assert "test-cpu-mxnet-py3_11" in svcs
+    assert len(svcs) >= 6
+
+
+def test_every_service_gets_build_and_suites():
+    svcs = parse_compose_services()
+    steps = build_pipeline(svcs)
+    builds = {s["key"] for s in steps if "key" in s}
+    assert builds == {f"build-{s}" for s in svcs}
+    # every service runs every common suite, after its build
+    for svc in svcs:
+        mine = [s for s in steps if s.get("depends_on") == f"build-{svc}"]
+        labels = {s["label"] for s in mine}
+        for name, _cmd, _t in COMMON_SUITES:
+            assert any(name in l for l in labels), (svc, labels)
+    # launcher/bridge extras land exactly on the matching services
+    for needle, extras in EXTRA_SUITES.items():
+        for svc in svcs:
+            mine = [s["label"] for s in steps
+                    if s.get("depends_on") == f"build-{svc}"]
+            for name, _cmd, _t in extras:
+                if needle in svc:
+                    assert any(name in l for l in mine), (svc, mine)
+                else:
+                    assert not any(name in l for l in mine), (svc, mine)
+
+
+def test_wait_barrier_between_build_and_test():
+    steps = build_pipeline(parse_compose_services())
+    kinds = ["wait" if list(s.keys()) == ["wait"] else
+             ("build" if "key" in s else "test") for s in steps]
+    w = kinds.index("wait")
+    assert all(k == "build" for k in kinds[:w])
+    assert all(k == "test" for k in kinds[w + 1:])
+
+
+def test_step_commands_reference_existing_paths():
+    """Every pytest path named in a generated command must exist — a
+    renamed test file must fail generation review, not a nightly."""
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    steps = build_pipeline(parse_compose_services())
+    for s in steps:
+        for path in re.findall(r"tests/[A-Za-z0-9_/.]+", s.get("command", "")):
+            assert os.path.exists(os.path.join(root, path)), \
+                (path, s["command"])
+    assert os.path.exists(os.path.join(root, "ci/docker-compose.test.yml"))
+
+
+def test_emitted_yaml_shape():
+    out = emit_yaml(build_pipeline(parse_compose_services()))
+    assert out.startswith("steps:")
+    assert "- wait" in out
+    # quick structural sanity: every step line pair label->command
+    labels = out.count("- label:")
+    commands = out.count("  command:")
+    assert labels == commands and labels > 10
+
+
+def test_cli_runs():
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    r = subprocess.run(
+        [sys.executable, os.path.join(root, "ci", "gen_pipeline.py")],
+        capture_output=True, text=True, timeout=60)
+    assert r.returncode == 0
+    assert r.stdout.startswith("steps:")

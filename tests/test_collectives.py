@@ -280,3 +280,17 @@ def test_jax_array_inputs_stay_on_device(hvd_world):
     # bf16 path (no numpy-native dtype) survives too
     hb = _c.allreduce(jnp.ones((3,), jnp.bfloat16), op=_c.Sum, name="jx.bf")
     assert str(np.asarray(hb).dtype) == "bfloat16"
+
+
+def test_joined_zero_substitution_preserves_residency(hvd_world):
+    """join()'s zero substitution must keep each member's host/device
+    residency: the hybrid routing is part of the compiled SPMD program
+    and must stay identical across ranks (round-5 review finding)."""
+    import jax
+    import jax.numpy as jnp
+    from horovod_tpu.collectives import _zeros_like_staged
+    z = _zeros_like_staged(np.ones(4, np.float32))
+    assert isinstance(z, np.ndarray) and not z.any()
+    zd = _zeros_like_staged(jnp.ones((2, 3), jnp.float32))
+    assert isinstance(zd, jax.Array) and not np.asarray(zd).any()
+    assert zd.shape == (2, 3)

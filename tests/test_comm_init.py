@@ -106,3 +106,27 @@ def test_init_comm_real_mpi4py():
                                    np.full(2, float(hvd.size())))
     finally:
         hvd.shutdown()
+
+
+def test_routable_host_never_loopback_when_route_exists():
+    """The comm-rendezvous coordinator address must be dialable by
+    remote peers: when the hostname resolves to 127.x (stock Debian
+    /etc/hosts), the default-route interface IP is used instead."""
+    from horovod_tpu.basics import _routable_host
+    import socket
+    host = _routable_host()
+    assert host
+    try:
+        resolved = socket.gethostbyname(host)
+    except OSError:
+        resolved = host
+    # either a non-loopback resolution, or the box genuinely has no
+    # route (then the hostname fallback is the best available)
+    try:
+        with socket.socket(socket.AF_INET, socket.SOCK_DGRAM) as s:
+            s.connect(("8.8.8.8", 53))
+            has_route = not s.getsockname()[0].startswith("127.")
+    except OSError:
+        has_route = False
+    if has_route:
+        assert not resolved.startswith("127."), (host, resolved)

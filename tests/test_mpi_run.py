@@ -477,3 +477,18 @@ class TestRunController:
             use_mpi=False, mpi_fn=mpi_fn, use_jsrun=False, js_fn=js_fn,
             use_local=False, local_fn=local_fn, args=args)
         assert rc == 0 and log == ["local"]
+
+    def test_cross_identity_not_derived_for_heterogeneous_slurm(self):
+        """SLURM per-node lists like '2,4' truncate under parse(); they
+        must disqualify the cross derivation, not silently pass the
+        uniformity check (round-5 review finding)."""
+        from horovod_tpu.config import mpi_task_identity
+        env = {"SLURM_PROCID": "5", "SLURM_STEP_NUM_TASKS": "6",
+               "SLURM_LOCALID": "1",
+               "SLURM_STEP_TASKS_PER_NODE": "2,4"}
+        ident = mpi_task_identity(env)
+        assert "CROSS_RANK" not in ident and "CROSS_SIZE" not in ident
+        # the uniform "N(xM)" form still derives
+        env["SLURM_STEP_TASKS_PER_NODE"] = "3(x2)"
+        ident = mpi_task_identity(env)
+        assert ident["CROSS_RANK"] == 1 and ident["CROSS_SIZE"] == 2
