@@ -201,6 +201,10 @@ def _result_json(r, backend_label, note=""):
     }
     if r.mfu is not None:
         out["mfu"] = round(r.mfu, 4)
+    if getattr(r, "stem", None):
+        # which ResNet stem produced this line (the r5 A/B is part of the
+        # official record)
+        out["stem"] = r.stem
     if r.flops_per_step:
         out["flops_per_step"] = r.flops_per_step
     if note:
@@ -247,28 +251,29 @@ def worker_main(cpu: bool, batch_override=None):
             # Stage 1: same compiled step, a quick honest measurement.
             dict(batch_per_chip=32, num_warmup_batches=2,
                  num_batches_per_iter=5, num_iters=2),
-            # Stages 2-3: large batches with the SCANNED k-step program
-            # (one XLA call per timed iteration — no per-step host
-            # dispatch in the measurement), re-printing improved lines.
-            # Each costs a fresh compile. r4 measurements on a live v5e:
-            # batch 32→1694, 64→1866, 128→2372, 256→2405 img/s
-            # (mfu 0.21/0.23/0.28/0.30) — intermediate sizes are not
-            # worth their compiles, so the ladder jumps straight to the
-            # MFU-bearing batches. 512 was probed and rejected: its
-            # compile alone exceeds 420 s on v5e (HBM-pressure layout
-            # search), so it can never pay for itself within the budget.
-            dict(batch_per_chip=128, num_warmup_batches=5,
-                 num_batches_per_iter=10, num_iters=10, scanned=True),
+            # Stages 2-3: the MFU-bearing batch with the SCANNED k-step
+            # program (one XLA call per timed iteration — no per-step
+            # host dispatch in the measurement), re-printing improved
+            # lines. Each costs a fresh compile. r4 measurements on a
+            # live v5e: batch 32→1694, 64→1866, 128→2372, 256→2405 img/s
+            # (mfu 0.21/0.23/0.28/0.30) — so the ladder jumps straight to
+            # batch 256 and spends the next budget slot on the stem A/B
+            # at that batch (r5: the A/B is the top open measurement; the
+            # slot previously re-measured batch 128, a known-worse
+            # point). 512 was probed and rejected: its compile alone
+            # exceeds 420 s on v5e (HBM-pressure layout search), so it
+            # can never pay for itself within the budget.
             dict(batch_per_chip=256, num_warmup_batches=5,
                  num_batches_per_iter=10, num_iters=10, scanned=True),
-            # Opportunistic: the math-equivalent space-to-depth stem
-            # (models/resnet.py SpaceToDepthStem) re-measured at the best
-            # batch. Usually skipped on a 420 s budget (stage margin);
-            # with a larger budget, best-line semantics keep whichever
-            # stem wins.
+            # The math-equivalent space-to-depth stem (models/resnet.py
+            # SpaceToDepthStem) at the same batch; best-line semantics
+            # keep whichever stem wins.
             dict(batch_per_chip=256, num_warmup_batches=5,
                  num_batches_per_iter=10, num_iters=10, scanned=True,
                  stem="space_to_depth"),
+            # Larger budgets only: the secondary batch point.
+            dict(batch_per_chip=128, num_warmup_batches=5,
+                 num_batches_per_iter=10, num_iters=10, scanned=True),
         ]
 
     best_v = -1.0

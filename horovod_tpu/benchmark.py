@@ -30,6 +30,7 @@ class BenchResult:
     device_kind: str = "unknown"
     flops_per_step: Optional[float] = None
     mfu: Optional[float] = None
+    stem: str = "conv"
 
 
 # Peak dense bf16 FLOP/s per chip by device kind (public spec-sheet numbers;
@@ -258,6 +259,7 @@ class _Rig:
             device_kind=self.device_kind,
             flops_per_step=self.flops_per_step,
             mfu=mfu,
+            stem=self.stem,
         )
 
 

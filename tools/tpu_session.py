@@ -106,8 +106,9 @@ def main():
         row = bench_mod._result_json(r, "tpu")
         row["stem"] = st["stem"]
         _append_session({"stage": st, **row})
+        mfu = f"{r.mfu:.4f}" if r.mfu is not None else "n/a"
         _log(f"stage {i}: stem={st['stem']} batch={r.batch_per_chip} "
-             f"{r.images_per_sec_per_chip:.1f} img/s mfu={r.mfu:.4f} "
+             f"{r.images_per_sec_per_chip:.1f} img/s mfu={mfu} "
              f"({time.time() - t0:.0f}s)")
         if best is None or row["value"] > best["value"]:
             best = row
@@ -123,8 +124,11 @@ def main():
         from horovod_tpu.benchmark import _Rig
         rig = _Rig(best["batch_per_chip"], 224, "resnet50", "sgd",
                    stem=best["stem"])
-        rig.run_stage(num_warmup_batches=2, num_batches_per_iter=5,
-                      num_iters=1, scanned=True)  # compile + warm
+        # warm with the SAME k as the traced run: run_stage compiles the
+        # k-step program on first use, and a compile inside the trace
+        # would drown the activity being attributed
+        rig.run_stage(num_warmup_batches=2, num_batches_per_iter=10,
+                      num_iters=1, scanned=True)
         jax.profiler.start_trace(logdir)
         rig.run_stage(num_warmup_batches=0, num_batches_per_iter=10,
                       num_iters=1, scanned=True)
