@@ -55,7 +55,7 @@ class EstimatorParams:
         "model", "optimizer", "loss", "metrics", "feature_cols",
         "label_cols", "output_cols", "batch_size", "epochs",
         "validation", "sample_weight_col", "num_proc", "store", "run_id",
-        "verbose", "shuffle", "random_seed",
+        "verbose", "shuffle", "random_seed", "streaming",
     ]
 
     def __init__(self, **kwargs):
@@ -80,6 +80,10 @@ class EstimatorParams:
         self.verbose = 0
         self.shuffle = True
         self.random_seed = 0
+        #: stream row groups through ParquetBatchIterator instead of
+        #: materializing the shard in memory (the Petastorm reader role;
+        #: datasets larger than worker RAM). Torch estimator only.
+        self.streaming = False
         for k, v in kwargs.items():
             if k not in self._param_names:
                 raise TypeError(f"unknown estimator param {k!r}")
@@ -193,12 +197,15 @@ class HorovodEstimator(EstimatorParams):
             rank, size = 0, 1
         return train_fn(rank, size, train_path)
 
+    def _pre_fit_validate(self) -> None:
+        """Param validation that must run BEFORE the (possibly expensive)
+        Parquet materialization. Subclasses extend (and call super)."""
+        self._validation_spec()
+
     def fit(self, df):
         """Materialize ``df`` and train; returns the fitted Model
         transformer (reference: estimator.py fit / _fit_on_prepared_data)."""
-        # validate shared params BEFORE the (possibly expensive) Parquet
-        # materialization, identically for every framework subclass
-        self._validation_spec()
+        self._pre_fit_validate()
         train_path = self._materialize(df)
         train_fn = self._make_train_fn()
         result = self._run_distributed(train_fn, train_path)

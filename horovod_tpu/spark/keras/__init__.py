@@ -45,6 +45,15 @@ class KerasEstimator(HorovodEstimator):
         "custom_objects",
     ]
 
+    def _pre_fit_validate(self) -> None:
+        super()._pre_fit_validate()
+        if self.streaming:
+            # silently materializing would hand the user the exact OOM
+            # they set the flag to avoid
+            raise ValueError(
+                "streaming=True is implemented for TorchEstimator only; "
+                "KerasEstimator materializes the worker shard in memory")
+
     def __init__(self, **kwargs):
         #: name -> class/function mapping shipped to workers so custom
         #: layers/losses deserialize (reference keras estimator

@@ -232,3 +232,120 @@ def read_parquet_shard(path: str, columns: List[str], rank: int = 0,
         arr = np.asarray(col)
         out.append(arr[rank::size])
     return out
+
+
+class ParquetBatchIterator:
+    """Stream batches from a Parquet dataset directory WITHOUT
+    materializing it — the Petastorm reader role (reference:
+    spark/common/store.py + keras/estimator.py feed workers through
+    petastorm's make_batch_reader). Sharding is by ROW GROUP round-robin
+    across ranks, so a worker's memory footprint is one row group plus
+    one batch regardless of dataset size.
+
+    Yields ``{column: np.ndarray}`` dicts of up to ``batch_size`` rows;
+    the final partial batch is yielded unless ``drop_last``. ``shuffle``
+    permutes row-group order and rows within each row group from
+    ``seed`` (new permutation per epoch via :meth:`set_epoch`, the
+    torch-sampler convention).
+    """
+
+    def __init__(self, path, columns, batch_size: int, rank: int = 0,
+                 size: int = 1, fs=None, shuffle: bool = False,
+                 seed: int = 0, drop_last: bool = False):
+        import pyarrow.parquet as pq
+
+        self.path, self.columns = path, list(columns)
+        self.batch_size, self.rank, self.size = int(batch_size), rank, size
+        self.fs, self.shuffle, self.seed = fs, shuffle, int(seed)
+        self.drop_last = drop_last
+        self._epoch = 0
+        if fs is None:
+            self._files = sorted(
+                os.path.join(path, f) for f in os.listdir(path)
+                if f.endswith(".parquet"))
+        else:
+            self._files = sorted(f for f in fs.ls(path, detail=False)
+                                 if f.endswith(".parquet"))
+        if not self._files:
+            raise FileNotFoundError(f"no parquet files under {path}")
+        # Row-group counts from the footers ONCE (read_metadata touches
+        # only the footer); epochs then open just the files whose groups
+        # this rank owns, and close them when consumed.
+        self._rg_counts = []
+        for f in self._files:
+            if fs is None:
+                self._rg_counts.append(pq.read_metadata(f).num_row_groups)
+            else:
+                with fs.open(f, "rb") as fh:
+                    self._rg_counts.append(
+                        pq.read_metadata(fh).num_row_groups)
+
+    def set_epoch(self, epoch: int) -> None:
+        self._epoch = int(epoch)
+
+    def _open(self, f):
+        """Returns (ParquetFile, closer)."""
+        import pyarrow.parquet as pq
+        if self.fs is None:
+            pf = pq.ParquetFile(f)
+            return pf, pf.close
+        fh = self.fs.open(f, "rb")
+        pf = pq.ParquetFile(fh)
+
+        def close():
+            pf.close()
+            fh.close()
+        return pf, close
+
+    def __iter__(self):
+        import numpy as np
+
+        # global row-group list (file idx, rg idx), sharded round-robin
+        groups = [(fi, g) for fi, cnt in enumerate(self._rg_counts)
+                  for g in range(cnt)]
+        mine = [g for i, g in enumerate(groups)
+                if i % self.size == self.rank]
+        rng = np.random.RandomState(self.seed + self._epoch) \
+            if self.shuffle else None
+        if rng is not None:
+            rng.shuffle(mine)
+
+        readers = {}   # fi -> (ParquetFile, closer), opened on demand
+        remaining = {}  # fi -> groups of mine not yet consumed
+        for fi, _gi in mine:
+            remaining[fi] = remaining.get(fi, 0) + 1
+        try:
+            pending = None  # dict col -> ndarray of buffered rows
+            for fi, gi in mine:
+                if fi not in readers:
+                    readers[fi] = self._open(self._files[fi])
+                tbl = readers[fi][0].read_row_group(
+                    gi, columns=self.columns)
+                remaining[fi] -= 1
+                if remaining[fi] == 0:
+                    readers.pop(fi)[1]()
+                cols = {c: np.asarray(tbl.column(c).to_pylist())
+                        for c in self.columns}
+                if rng is not None:
+                    n = len(next(iter(cols.values())))
+                    perm = rng.permutation(n)
+                    cols = {c: v[perm] for c, v in cols.items()}
+                if pending is None:
+                    pending = cols
+                else:
+                    pending = {c: np.concatenate([pending[c], cols[c]])
+                               for c in self.columns}
+                n = len(next(iter(pending.values())))
+                off = 0
+                while n - off >= self.batch_size:
+                    yield {c: v[off:off + self.batch_size]
+                           for c, v in pending.items()}
+                    off += self.batch_size
+                pending = {c: v[off:] for c, v in pending.items()}
+            if pending is not None and not self.drop_last:
+                n = len(next(iter(pending.values())))
+                if n:
+                    yield pending
+        finally:
+            for _pf, close in readers.values():
+                close()

@@ -42,6 +42,19 @@ class TorchEstimator(HorovodEstimator):
     factory expresses the same contract without private state surgery).
     """
 
+    def _pre_fit_validate(self) -> None:
+        super()._pre_fit_validate()
+        spec = self._validation_spec()
+        if self.streaming and spec and spec[0] == "fraction":
+            # a fraction split needs the shard length up front, which
+            # streaming exists to avoid; the column form filters per
+            # batch. Raised HERE so the user does not pay a full Parquet
+            # materialization for a config error.
+            raise ValueError(
+                "streaming=True supports the validation COLUMN form "
+                "(rows with column value > 0), not a fraction — the "
+                "fraction split would require materializing the shard")
+
     def _make_train_fn(self):
         blob = _serialize_torch(self.model)
         opt_factory = self.optimizer
@@ -53,6 +66,7 @@ class TorchEstimator(HorovodEstimator):
         validation_spec = self._validation_spec()
         sample_weight_col = self.sample_weight_col
         fs = getattr(self._resolve_store(), "fs", None)
+        streaming = bool(self.streaming)
         # metrics: fn(outputs, targets) -> scalar, evaluated per epoch on
         # the held-out set (reference: TorchEstimator metrics,
         # spark/torch/estimator.py evaluation on the val DataLoader).
@@ -89,27 +103,6 @@ class TorchEstimator(HorovodEstimator):
                 opt = hvd_t.DistributedOptimizer(
                     opt, named_parameters=model.named_parameters())
 
-            train, val, w_t, w_v = load_split_shard(
-                train_path, feature_cols, label_cols, rank, size,
-                sample_weight_col=sample_weight_col,
-                validation_spec=validation_spec, fs=fs)
-            x = _stack(train[:len(feature_cols)]).astype(np.float32)
-            y = _stack(train[len(feature_cols):]).astype(np.float32)
-            xt, yt = torch.from_numpy(x), torch.from_numpy(y)
-            if yt.ndim == 1:
-                yt = yt[:, None]
-            wt = torch.from_numpy(np.asarray(w_t, np.float32)) \
-                if w_t is not None else None
-            n_val = 0
-            if val is not None:
-                xv = torch.from_numpy(
-                    _stack(val[:len(feature_cols)]).astype(np.float32))
-                yv = torch.from_numpy(
-                    _stack(val[len(feature_cols):]).astype(np.float32))
-                if yv.ndim == 1:
-                    yv = yv[:, None]
-                n_val = len(xv)
-
             def batch_loss(pred, target, weights):
                 """Per-row weighting (reference `sample_weight_col`):
                 computed through the loss's reduction='none' form, then
@@ -132,11 +125,166 @@ class TorchEstimator(HorovodEstimator):
                 return (per * weights).sum() / weights.sum().clamp_min(
                     torch.finfo(weights.dtype).tiny)
 
-            g = torch.Generator().manual_seed(seed)
-            n = len(xt)
             history = []
             val_history = []
             metrics_history = {name: [] for name in metric_fns}
+
+            def eval_val(xv, yv):
+                # eval mode: dropout off, batchnorm uses (and does not
+                # update) running stats — the held-out set must not leak
+                # into the shipped model. Snapshot the PRIOR mode PER
+                # SUBMODULE: a user may have frozen individual layers via
+                # .eval() before handing the model over, and root-level
+                # train() would unfreeze them.
+                modes = [(m, m.training) for m in model.modules()]
+                model.eval()
+                with torch.no_grad():
+                    out_v = model(xv)
+                    val_history.append(float(loss_fn(out_v, yv)))
+                    for name, fn in metric_fns.items():
+                        metrics_history[name].append(float(fn(out_v, yv)))
+                for m, was_training in modes:
+                    m.training = was_training
+
+            def finish():
+                state = {k: v.cpu().numpy() if hasattr(v, "cpu") else v
+                         for k, v in model.state_dict().items()}
+                return {"state_dict": state, "loss_history": history,
+                        "val_loss_history": val_history,
+                        "metrics_history": metrics_history}
+
+            if streaming:
+                # Petastorm-reader mode: row groups stream through
+                # ParquetBatchIterator; memory holds one row group + one
+                # batch (+ the usually-small validation subset when the
+                # validation column selects one).
+                #
+                # Multi-process lockstep: row-group sharding gives ranks
+                # UNEQUAL batch counts (unlike the in-memory rank::size
+                # row split), and every opt.step() is a collective — so
+                # each step first agrees via a Max-allreduce whether ANY
+                # rank still has data, and a starved rank participates
+                # with an explicit zero-gradient step (forward on a zero
+                # batch scaled by 0.0, so the bucket hooks fire and
+                # submit zeros — the Join convention, reference
+                # tensor_queue.cc zero substitution).
+                from ... import collectives as _coll
+                from ..store import ParquetBatchIterator
+
+                val_col = (validation_spec[1] if validation_spec else None)
+                extra = ([sample_weight_col] if sample_weight_col else []) \
+                    + ([val_col] if val_col else [])
+                it = ParquetBatchIterator(
+                    train_path, feature_cols + label_cols + extra,
+                    batch_size, rank, size, fs=fs, shuffle=shuffle,
+                    seed=seed)
+                zero_x = None
+
+                def get_zero_x():
+                    # template input for zero-grad participation; a rank
+                    # can be starved an entire epoch (fewer row groups
+                    # than ranks), so fall back to one template row read
+                    # from the dataset itself
+                    nonlocal zero_x
+                    if zero_x is None:
+                        t = next(iter(ParquetBatchIterator(
+                            train_path, feature_cols, 1, 0, 1, fs=fs)))
+                        width = _stack(
+                            [t[c] for c in feature_cols]).shape[1]
+                        zero_x = torch.zeros((1, width),
+                                             dtype=torch.float32)
+                    return zero_x
+
+                for epoch in range(epochs):
+                    it.set_epoch(epoch)
+                    epoch_loss, n_rows = 0.0, 0
+                    val_parts = []
+                    batches = iter(it)
+                    while True:
+                        batch = next(batches, None)
+                        while batch is not None and val_col is not None:
+                            vmask = np.asarray(batch[val_col]) > 0
+                            if vmask.any():
+                                val_parts.append(
+                                    {c: np.asarray(batch[c])[vmask]
+                                     for c in feature_cols + label_cols})
+                            keep = ~vmask
+                            if keep.any():
+                                batch = {c: np.asarray(v)[keep]
+                                         for c, v in batch.items()}
+                                break
+                            batch = next(batches, None)  # all-val batch
+                        have = batch is not None
+                        if size > 1:
+                            flag = _coll.allreduce(
+                                np.array([1.0 if have else 0.0],
+                                         np.float32),
+                                op=_coll.ReduceOp.MAX,
+                                name="spark_stream.have")
+                            if float(np.asarray(flag)[0]) <= 0:
+                                break
+                        elif not have:
+                            break
+                        if have:
+                            xb = _stack([batch[c] for c in feature_cols])
+                            yb = _stack([batch[c] for c in label_cols])
+                            xt = torch.from_numpy(xb.astype(np.float32))
+                            if zero_x is None:
+                                zero_x = torch.zeros(
+                                    (1, xt.shape[1]), dtype=torch.float32)
+                            yt = torch.from_numpy(yb.astype(np.float32))
+                            if yt.ndim == 1:
+                                yt = yt[:, None]
+                            wb = None
+                            if sample_weight_col:
+                                wb = torch.from_numpy(np.asarray(
+                                    batch[sample_weight_col], np.float32))
+                            opt.zero_grad()
+                            loss = batch_loss(model(xt), yt, wb)
+                            loss.backward()
+                            opt.step()
+                            epoch_loss += float(loss.detach()) * len(xt)
+                            n_rows += len(xt)
+                        else:
+                            opt.zero_grad()
+                            (model(get_zero_x()).sum() * 0.0).backward()
+                            opt.step()
+                    history.append(epoch_loss / max(n_rows, 1))
+                    if val_parts:
+                        xv = torch.from_numpy(_stack([
+                            np.concatenate([p[c] for p in val_parts])
+                            for c in feature_cols]).astype(np.float32))
+                        yv = torch.from_numpy(_stack([
+                            np.concatenate([p[c] for p in val_parts])
+                            for c in label_cols]).astype(np.float32))
+                        if yv.ndim == 1:
+                            yv = yv[:, None]
+                        eval_val(xv, yv)
+                return finish()
+
+            train, val, w_t, w_v = load_split_shard(
+                train_path, feature_cols, label_cols, rank, size,
+                sample_weight_col=sample_weight_col,
+                validation_spec=validation_spec, fs=fs)
+            x = _stack(train[:len(feature_cols)]).astype(np.float32)
+            y = _stack(train[len(feature_cols):]).astype(np.float32)
+            xt, yt = torch.from_numpy(x), torch.from_numpy(y)
+            if yt.ndim == 1:
+                yt = yt[:, None]
+            wt = torch.from_numpy(np.asarray(w_t, np.float32)) \
+                if w_t is not None else None
+            n_val = 0
+            if val is not None:
+                xv = torch.from_numpy(
+                    _stack(val[:len(feature_cols)]).astype(np.float32))
+                yv = torch.from_numpy(
+                    _stack(val[len(feature_cols):]).astype(np.float32))
+                if yv.ndim == 1:
+                    yv = yv[:, None]
+                n_val = len(xv)
+
+            g = torch.Generator().manual_seed(seed)
+            n = len(xt)
             for _ in range(epochs):
                 order = (torch.randperm(n, generator=g) if shuffle
                          else torch.arange(n))
@@ -151,28 +299,8 @@ class TorchEstimator(HorovodEstimator):
                     epoch_loss += float(loss.detach()) * len(idx)
                 history.append(epoch_loss / max(n, 1))
                 if n_val:
-                    # eval mode: dropout off, batchnorm uses (and does
-                    # not update) running stats — the held-out set must
-                    # not leak into the shipped model. Snapshot the PRIOR
-                    # mode PER SUBMODULE: a user may have frozen
-                    # individual layers via .eval() before handing the
-                    # model over, and root-level train() would unfreeze
-                    # them.
-                    modes = [(m, m.training) for m in model.modules()]
-                    model.eval()
-                    with torch.no_grad():
-                        out_v = model(xv)
-                        val_history.append(float(loss_fn(out_v, yv)))
-                        for name, fn in metric_fns.items():
-                            metrics_history[name].append(
-                                float(fn(out_v, yv)))
-                    for m, was_training in modes:
-                        m.training = was_training
-            state = {k: v.cpu().numpy() if hasattr(v, "cpu") else v
-                     for k, v in model.state_dict().items()}
-            return {"state_dict": state, "loss_history": history,
-                    "val_loss_history": val_history,
-                    "metrics_history": metrics_history}
+                    eval_val(xv, yv)
+            return finish()
 
         def _stack(arrays):
             out = [np.asarray(a) for a in arrays]

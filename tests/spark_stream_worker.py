@@ -1,0 +1,83 @@
+"""Worker for the 2-process streaming-estimator integration test.
+
+Rank 0 owns 2 of 3 row groups, rank 1 owns 1 — the unequal-step case
+that deadlocks naive streaming (every opt.step() is a collective). The
+lockstep protocol must let both ranks finish, with identical final
+parameters (allreduce keeps them in sync; the starved rank's extra
+steps contribute zeros, the Join convention)."""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+
+def main() -> int:
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import torch
+
+    import horovod_tpu as hvd
+    from horovod_tpu.spark.store import (LocalStore, ParquetBatchIterator,
+                                         write_parquet)
+    from horovod_tpu.spark.torch import TorchEstimator
+
+    hvd.init()
+    rank, size = hvd.rank(), hvd.size()
+    scratch = os.environ["STREAM_TEST_DIR"]
+
+    # dataset with exactly 3 row groups (1 file x 3 groups of 64)
+    data_dir = os.path.join(scratch, "ds")
+    if rank == 0:
+        rng = np.random.RandomState(0)
+        x = rng.randn(192, 4).astype(np.float32)
+        w = np.array([[1.0], [-2.0], [0.5], [2.0]], np.float32)
+        cols = {f"f{i}": x[:, i] for i in range(4)}
+        cols["label"] = (x @ w).ravel()
+        write_parquet(data_dir, cols, row_group_rows=64, partitions=1)
+    hvd.barrier()
+
+    # uneven shard proof: rank 0 sees 2 groups, rank 1 sees 1
+    n_batches = sum(1 for _ in ParquetBatchIterator(
+        data_dir, ["label"], batch_size=64, rank=rank, size=size))
+    expected = 2 if rank == 0 else 1
+    assert n_batches == expected, (rank, n_batches)
+
+    # Train through the estimator's streaming train_fn against the
+    # SHARED pre-materialized dataset (rank 0 wrote it above; calling
+    # fit() on every rank would race the materialization, so the worker
+    # drives the train fn directly — the lockstep protocol under test
+    # lives entirely inside it).
+    torch.manual_seed(5)
+    net = torch.nn.Linear(4, 1)
+    est = TorchEstimator(
+        model=net, optimizer=lambda p: torch.optim.SGD(p, lr=1e-2),
+        loss=torch.nn.MSELoss(), shuffle=False, streaming=True,
+        feature_cols=[f"f{i}" for i in range(4)], label_cols=["label"],
+        batch_size=64, epochs=3,
+        store=LocalStore(os.path.join(scratch, "store")),
+        run_id="stream2p")
+    train_fn = est._make_train_fn()
+    result = train_fn(rank, size, data_dir)
+    hist = result["loss_history"]
+    assert hist[-1] < hist[0], hist
+
+    # parameters must be identical across ranks (allreduced training)
+    flat = np.concatenate(
+        [np.asarray(v).ravel() for v in result["state_dict"].values()])
+    gathered = np.asarray(hvd.allgather(flat[None, :], name="params"))
+    np.testing.assert_allclose(gathered[0], gathered[1], atol=1e-6)
+
+    print(f"stream worker {rank} OK batches={n_batches}", flush=True)
+    hvd.shutdown()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -593,3 +593,140 @@ def test_store_create_unknown_scheme_still_errors():
     from horovod_tpu.spark.store import Store
     with pytest.raises(ValueError, match="scheme"):
         Store.create("notascheme9x://bucket/path")
+
+
+class TestStreamingReader:
+    """ParquetBatchIterator — the Petastorm reader role (reference:
+    petastorm make_batch_reader feeding estimator workers)."""
+
+    def _dataset(self, tmp_path, n=1000, partitions=3, rgr=64):
+        from horovod_tpu.spark.store import write_parquet
+        path = str(tmp_path / "ds")
+        write_parquet(path, {
+            "idx": np.arange(n, dtype=np.int64),
+            "x": np.arange(n, dtype=np.float32) * 2.0,
+        }, row_group_rows=rgr, partitions=partitions)
+        return path
+
+    def test_every_row_exactly_once_across_ranks(self, tmp_path):
+        from horovod_tpu.spark.store import ParquetBatchIterator
+        path = self._dataset(tmp_path)
+        seen = []
+        for rank in range(3):
+            it = ParquetBatchIterator(path, ["idx"], batch_size=37,
+                                      rank=rank, size=3)
+            for batch in it:
+                seen.extend(batch["idx"].tolist())
+        assert sorted(seen) == list(range(1000))
+
+    def test_batch_sizes_and_partial_last(self, tmp_path):
+        from horovod_tpu.spark.store import ParquetBatchIterator
+        path = self._dataset(tmp_path, n=100, partitions=1, rgr=32)
+        sizes = [len(b["idx"]) for b in ParquetBatchIterator(
+            path, ["idx"], batch_size=48)]
+        assert sizes == [48, 48, 4]
+        sizes = [len(b["idx"]) for b in ParquetBatchIterator(
+            path, ["idx"], batch_size=48, drop_last=True)]
+        assert sizes == [48, 48]
+
+    def test_columns_consistent_within_batch(self, tmp_path):
+        from horovod_tpu.spark.store import ParquetBatchIterator
+        path = self._dataset(tmp_path)
+        for batch in ParquetBatchIterator(path, ["idx", "x"],
+                                          batch_size=64, shuffle=True):
+            np.testing.assert_allclose(batch["x"],
+                                       batch["idx"].astype(np.float32) * 2)
+
+    def test_shuffle_is_seeded_and_epoch_varies(self, tmp_path):
+        from horovod_tpu.spark.store import ParquetBatchIterator
+        path = self._dataset(tmp_path, n=256, partitions=1, rgr=64)
+
+        def first_batch(seed, epoch):
+            it = ParquetBatchIterator(path, ["idx"], batch_size=32,
+                                      shuffle=True, seed=seed)
+            it.set_epoch(epoch)
+            return next(iter(it))["idx"].tolist()
+
+        assert first_batch(1, 0) == first_batch(1, 0)
+        assert first_batch(1, 0) != first_batch(1, 1)
+        assert first_batch(1, 0) != first_batch(2, 0)
+        # shuffled stream still covers every row exactly once
+        it = ParquetBatchIterator(path, ["idx"], batch_size=32,
+                                  shuffle=True, seed=3)
+        assert sorted(i for b in it for i in b["idx"].tolist()) \
+            == list(range(256))
+
+    def test_memory_fs(self, tmp_path):
+        fsspec = pytest.importorskip("fsspec")
+        from horovod_tpu.spark.store import (ParquetBatchIterator,
+                                             write_parquet)
+        fs = fsspec.filesystem("memory")
+        path = "memory://stream-ds"
+        write_parquet(path, {"idx": np.arange(64, dtype=np.int64)},
+                      row_group_rows=16, fs=fs)
+        rows = [i for b in ParquetBatchIterator(
+            path, ["idx"], batch_size=10, fs=fs) for i in b["idx"]]
+        assert sorted(rows) == list(range(64))
+
+
+def test_torch_estimator_streaming_matches_memory(hvd_world, tmp_path):
+    """streaming=True trains through the row-group reader; with
+    shuffle=False the trajectory must EQUAL the in-memory path (same
+    batches in the same order)."""
+    torch = pytest.importorskip("torch")
+    from horovod_tpu.spark.torch import TorchEstimator
+    from horovod_tpu.spark.store import LocalStore
+
+    df = _regression_df(n=256)
+
+    def run(streaming, leaf):
+        torch.manual_seed(11)
+        net = torch.nn.Linear(4, 1)
+        return TorchEstimator(
+            model=net, optimizer=lambda p: torch.optim.SGD(p, lr=1e-2),
+            loss=torch.nn.MSELoss(), shuffle=False,
+            feature_cols=[f"f{i}" for i in range(4)],
+            label_cols=["label"], batch_size=32, epochs=3,
+            streaming=streaming,
+            store=LocalStore(str(tmp_path / leaf))).fit(df)
+
+    m_s = run(True, "stream")
+    m_m = run(False, "memory")
+    for k in m_m.model.state_dict():
+        np.testing.assert_allclose(
+            m_s.model.state_dict()[k].numpy(),
+            m_m.model.state_dict()[k].numpy(), atol=1e-5)
+    assert m_s.loss_history[-1] < m_s.loss_history[0]
+
+
+def test_torch_estimator_streaming_validation_column_and_weights(
+        hvd_world, tmp_path):
+    torch = pytest.importorskip("torch")
+    from horovod_tpu.spark.torch import TorchEstimator
+    from horovod_tpu.spark.store import LocalStore
+
+    df = _regression_df(n=256)
+    df["is_val"] = (np.arange(len(df)) % 4 == 0).astype(np.float64)
+    df["w"] = 1.0
+    m = TorchEstimator(
+        model=torch.nn.Linear(4, 1), loss=torch.nn.MSELoss(),
+        feature_cols=[f"f{i}" for i in range(4)], label_cols=["label"],
+        batch_size=32, epochs=2, streaming=True, validation="is_val",
+        sample_weight_col="w",
+        store=LocalStore(str(tmp_path))).fit(df)
+    assert len(m.val_loss_history) == 2
+    assert all(v > 0 for v in m.val_loss_history)
+
+
+def test_torch_estimator_streaming_rejects_fraction_validation(
+        hvd_world, tmp_path):
+    torch = pytest.importorskip("torch")
+    from horovod_tpu.spark.torch import TorchEstimator
+    from horovod_tpu.spark.store import LocalStore
+
+    with pytest.raises(ValueError, match="COLUMN"):
+        TorchEstimator(
+            model=torch.nn.Linear(4, 1), loss=torch.nn.MSELoss(),
+            feature_cols=[f"f{i}" for i in range(4)],
+            label_cols=["label"], streaming=True, validation=0.25,
+            store=LocalStore(str(tmp_path))).fit(_regression_df(n=64))

@@ -212,3 +212,21 @@ def test_matched_metadata_does_not_false_positive():
         # the marker proves the matched-mode path actually ran (a lost
         # env var would fall back to the mismatch mode and pass vacuously)
         assert f"rank {r}: OK" in out, out[-500:]
+
+
+STREAM_WORKER = os.path.join(os.path.dirname(__file__),
+                             "spark_stream_worker.py")
+
+
+@pytest.mark.integration
+def test_streaming_estimator_unequal_shards_2proc(tmp_path):
+    """Streaming row-group sharding gives ranks unequal batch counts
+    (2 vs 1 here); the lockstep protocol must finish both ranks with
+    identical parameters instead of deadlocking in the collective
+    optimizer (round-5 review finding)."""
+    codes, outs = _launch(2, script=STREAM_WORKER, timeout=240,
+                          extra_env={"STREAM_TEST_DIR": str(tmp_path)})
+    for i, (c, o) in enumerate(zip(codes, outs)):
+        assert c == 0, f"worker {i} failed (exit {c}):\n{o[-4000:]}"
+        assert f"stream worker {i} OK" in o
+    assert "batches=2" in outs[0] and "batches=1" in outs[1]
