@@ -167,6 +167,33 @@ class FsspecStore(FilesystemStore):
 # code streams local and remote datasets.
 # ---------------------------------------------------------------------------
 
+def _list_parquet_files(path: str, fs=None) -> List[str]:
+    """Sorted part files of a Parquet dataset directory (shared by the
+    in-memory shard reader and the streaming iterator, so both always
+    see the same file set)."""
+    if fs is None:
+        files = sorted(
+            os.path.join(path, f) for f in os.listdir(path)
+            if f.endswith(".parquet"))
+    else:
+        files = sorted(f for f in fs.ls(path, detail=False)
+                       if f.endswith(".parquet"))
+    if not files:
+        raise FileNotFoundError(f"no parquet files under {path}")
+    return files
+
+
+def _column_to_numpy(col):
+    """Arrow column -> numpy without boxing every cell: columnar
+    conversion for flat types; fixed-size list columns (the vector
+    encoding write_parquet uses) stack into a 2-d array."""
+    import numpy as np
+
+    arr = col.combine_chunks().to_numpy(zero_copy_only=False)
+    if arr.dtype == object:
+        arr = np.stack(arr)
+    return arr
+
 def write_parquet(path: str, columns: dict, row_group_rows: int = 4096,
                   partitions: int = 1, fs=None) -> None:
     """Write named numpy columns as one or more Parquet files under
@@ -211,27 +238,17 @@ def read_parquet_shard(path: str, columns: List[str], rank: int = 0,
     import pyarrow as pa
     import pyarrow.parquet as pq
 
+    files = _list_parquet_files(path, fs)
     if fs is None:
-        files = sorted(
-            os.path.join(path, f) for f in os.listdir(path)
-            if f.endswith(".parquet"))
         tables = [pq.read_table(f, columns=columns) for f in files]
     else:
-        files = sorted(f for f in fs.ls(path, detail=False)
-                       if f.endswith(".parquet"))
         tables = []
         for f in files:
             with fs.open(f, "rb") as fh:
                 tables.append(pq.read_table(fh, columns=columns))
-    if not files:
-        raise FileNotFoundError(f"no parquet files under {path}")
     table = pa.concat_tables(tables)
-    out = []
-    for c in columns:
-        col = table.column(c).to_pylist()
-        arr = np.asarray(col)
-        out.append(arr[rank::size])
-    return out
+    return [_column_to_numpy(table.column(c))[rank::size]
+            for c in columns]
 
 
 class ParquetBatchIterator:
@@ -259,15 +276,7 @@ class ParquetBatchIterator:
         self.fs, self.shuffle, self.seed = fs, shuffle, int(seed)
         self.drop_last = drop_last
         self._epoch = 0
-        if fs is None:
-            self._files = sorted(
-                os.path.join(path, f) for f in os.listdir(path)
-                if f.endswith(".parquet"))
-        else:
-            self._files = sorted(f for f in fs.ls(path, detail=False)
-                                 if f.endswith(".parquet"))
-        if not self._files:
-            raise FileNotFoundError(f"no parquet files under {path}")
+        self._files = _list_parquet_files(path, fs)
         # Row-group counts from the footers ONCE (read_metadata touches
         # only the footer); epochs then open just the files whose groups
         # this rank owns, and close them when consumed.
@@ -315,7 +324,30 @@ class ParquetBatchIterator:
         for fi, _gi in mine:
             remaining[fi] = remaining.get(fi, 0) + 1
         try:
-            pending = None  # dict col -> ndarray of buffered rows
+            # chunk-list buffering: row-group arrays accumulate in a
+            # list and concatenate ONCE per drain, so filling a batch
+            # from k small row groups copies each row O(1) times, not
+            # O(k) (quadratic pending-carry was a round-5 review find)
+            parts = []      # list of dict col -> ndarray
+            buffered = 0
+
+            def drain(final: bool):
+                nonlocal parts, buffered
+                merged = parts[0] if len(parts) == 1 else {
+                    c: np.concatenate([p[c] for p in parts])
+                    for c in self.columns}
+                off = 0
+                while buffered - off >= self.batch_size:
+                    yield {c: v[off:off + self.batch_size]
+                           for c, v in merged.items()}
+                    off += self.batch_size
+                if final and buffered - off and not self.drop_last:
+                    yield {c: v[off:] for c, v in merged.items()}
+                    off = buffered
+                parts = [{c: v[off:] for c, v in merged.items()}] \
+                    if buffered - off else []
+                buffered -= off
+
             for fi, gi in mine:
                 if fi not in readers:
                     readers[fi] = self._open(self._files[fi])
@@ -324,28 +356,18 @@ class ParquetBatchIterator:
                 remaining[fi] -= 1
                 if remaining[fi] == 0:
                     readers.pop(fi)[1]()
-                cols = {c: np.asarray(tbl.column(c).to_pylist())
+                cols = {c: _column_to_numpy(tbl.column(c))
                         for c in self.columns}
+                n = len(next(iter(cols.values())))
                 if rng is not None:
-                    n = len(next(iter(cols.values())))
                     perm = rng.permutation(n)
                     cols = {c: v[perm] for c, v in cols.items()}
-                if pending is None:
-                    pending = cols
-                else:
-                    pending = {c: np.concatenate([pending[c], cols[c]])
-                               for c in self.columns}
-                n = len(next(iter(pending.values())))
-                off = 0
-                while n - off >= self.batch_size:
-                    yield {c: v[off:off + self.batch_size]
-                           for c, v in pending.items()}
-                    off += self.batch_size
-                pending = {c: v[off:] for c, v in pending.items()}
-            if pending is not None and not self.drop_last:
-                n = len(next(iter(pending.values())))
-                if n:
-                    yield pending
+                parts.append(cols)
+                buffered += n
+                if buffered >= self.batch_size:
+                    yield from drain(final=False)
+            if buffered:
+                yield from drain(final=True)
         finally:
             for _pf, close in readers.values():
                 close()

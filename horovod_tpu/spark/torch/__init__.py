@@ -45,7 +45,8 @@ class TorchEstimator(HorovodEstimator):
     def _pre_fit_validate(self) -> None:
         super()._pre_fit_validate()
         spec = self._validation_spec()
-        if self.streaming and spec and spec[0] == "fraction":
+        if self.streaming and spec and spec[0] == "fraction" \
+                and spec[1] > 0:
             # a fraction split needs the shard length up front, which
             # streaming exists to avoid; the column form filters per
             # batch. Raised HERE so the user does not pay a full Parquet
@@ -171,7 +172,9 @@ class TorchEstimator(HorovodEstimator):
                 from ... import collectives as _coll
                 from ..store import ParquetBatchIterator
 
-                val_col = (validation_spec[1] if validation_spec else None)
+                val_col = (validation_spec[1]
+                           if validation_spec
+                           and validation_spec[0] == "column" else None)
                 extra = ([sample_weight_col] if sample_weight_col else []) \
                     + ([val_col] if val_col else [])
                 it = ParquetBatchIterator(
@@ -246,8 +249,21 @@ class TorchEstimator(HorovodEstimator):
                             epoch_loss += float(loss.detach()) * len(xt)
                             n_rows += len(xt)
                         else:
-                            opt.zero_grad()
-                            (model(get_zero_x()).sum() * 0.0).backward()
+                            # zero-grad participation runs the forward in
+                            # eval mode: BatchNorm in train mode rejects
+                            # a 1-row batch and would smear zeros into
+                            # running stats on this rank only (buffers
+                            # are not allreduced); grads are zero either
+                            # way because of the * 0.0
+                            modes = [(m, m.training)
+                                     for m in model.modules()]
+                            model.eval()
+                            try:
+                                opt.zero_grad()
+                                (model(get_zero_x()).sum() * 0.0).backward()
+                            finally:
+                                for m, was in modes:
+                                    m.training = was
                             opt.step()
                     history.append(epoch_loss / max(n_rows, 1))
                     if val_parts:

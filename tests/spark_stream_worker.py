@@ -54,8 +54,13 @@ def main() -> int:
     # fit() on every rank would race the materialization, so the worker
     # drives the train fn directly — the lockstep protocol under test
     # lives entirely inside it).
+    # BatchNorm covers the starved-rank zero-step corner: a train-mode
+    # forward on the 1-row zero batch would crash BN and smear its
+    # running stats; the eval-mode zero step must not (round-5 review)
     torch.manual_seed(5)
-    net = torch.nn.Linear(4, 1)
+    net = torch.nn.Sequential(
+        torch.nn.Linear(4, 8), torch.nn.BatchNorm1d(8),
+        torch.nn.ReLU(), torch.nn.Linear(8, 1))
     est = TorchEstimator(
         model=net, optimizer=lambda p: torch.optim.SGD(p, lr=1e-2),
         loss=torch.nn.MSELoss(), shuffle=False, streaming=True,
@@ -68,9 +73,14 @@ def main() -> int:
     hist = result["loss_history"]
     assert hist[-1] < hist[0], hist
 
-    # parameters must be identical across ranks (allreduced training)
+    # LEARNABLE parameters must be identical across ranks (allreduced
+    # training); BN running stats are per-rank local by design — the
+    # reference's plain DP has the same property (SyncBatchNorm exists
+    # for when they must match)
+    learnable = [k for k in result["state_dict"]
+                 if "running_" not in k and "num_batches" not in k]
     flat = np.concatenate(
-        [np.asarray(v).ravel() for v in result["state_dict"].values()])
+        [np.asarray(result["state_dict"][k]).ravel() for k in learnable])
     gathered = np.asarray(hvd.allgather(flat[None, :], name="params"))
     np.testing.assert_allclose(gathered[0], gathered[1], atol=1e-6)
 

