@@ -25,7 +25,7 @@ import horovod_tpu as hvd
 def main():
     p = argparse.ArgumentParser()
     p.add_argument("--model", default="resnet50",
-                   choices=("resnet50", "resnet18"),
+                   choices=("resnet50", "resnet18", "resnet101", "vgg16", "inception3"),
                    help="benchmark model (reference --model knob)")
     p.add_argument("--batch-size", type=int, default=32,
                    help="batch size per chip")

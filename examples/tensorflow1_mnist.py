@@ -1,0 +1,82 @@
+"""TF1 graph/session-mode training through horovod_tpu (round 5).
+
+Reference counterpart: /root/reference/examples/tensorflow_mnist.py — the
+legacy recipe: build a graph, wrap the TF1 optimizer with
+DistributedOptimizer (compute_gradients reduces), train under
+MonitoredTrainingSession with BroadcastGlobalVariablesHook. Runs on
+synthetic MNIST-shaped data; the graph lives in an explicit tf.Graph so
+the script coexists with TF2 eager elsewhere in the process.
+"""
+
+import argparse
+
+import numpy as np
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=200)
+    ap.add_argument("--batch-size", type=int, default=64)
+    ap.add_argument("--lr", type=float, default=0.05)
+    args = ap.parse_args()
+
+    import tensorflow as tf
+
+    import horovod_tpu as hvd
+    import horovod_tpu.tensorflow as hvd_tf
+
+    hvd.init()
+    rng = np.random.RandomState(1234 + hvd.rank())
+
+    graph = tf.Graph()
+    with graph.as_default():
+        images = tf.compat.v1.placeholder(tf.float32, [None, 784], "images")
+        labels = tf.compat.v1.placeholder(tf.int64, [None], "labels")
+        # raw-variable layers (tf.compat.v1.layers is gone under Keras 3)
+        w1 = tf.compat.v1.get_variable(
+            "w1", [784, 128],
+            initializer=tf.compat.v1.glorot_uniform_initializer())
+        b1 = tf.compat.v1.get_variable(
+            "b1", [128], initializer=tf.compat.v1.zeros_initializer())
+        hidden = tf.nn.relu(tf.matmul(images, w1) + b1)
+        w2 = tf.compat.v1.get_variable(
+            "w2", [128, 10],
+            initializer=tf.compat.v1.glorot_uniform_initializer())
+        b2 = tf.compat.v1.get_variable(
+            "b2", [10], initializer=tf.compat.v1.zeros_initializer())
+        logits = tf.matmul(hidden, w2) + b2
+        loss = tf.reduce_mean(
+            tf.compat.v1.losses.sparse_softmax_cross_entropy(
+                labels=labels, logits=logits))
+
+        # reference recipe: scale LR by world size, wrap the TF1
+        # optimizer — compute_gradients now allreduces
+        opt = tf.compat.v1.train.GradientDescentOptimizer(
+            args.lr * hvd.size())
+        opt = hvd_tf.DistributedOptimizer(opt)
+        global_step = tf.compat.v1.train.get_or_create_global_step()
+        train_op = opt.minimize(loss, global_step=global_step)
+
+        hooks = [hvd_tf.BroadcastGlobalVariablesHook(root_rank=0)]
+        with tf.compat.v1.train.MonitoredTrainingSession(
+                hooks=hooks) as sess:
+            last = None
+            for step in range(args.steps):
+                # synthetic MNIST: each class lights its own pixel block
+                y = rng.randint(0, 10, size=args.batch_size)
+                x = 0.1 * rng.randn(args.batch_size, 784)
+                for i, cls in enumerate(y):
+                    x[i, cls * 78:(cls + 1) * 78] += 1.0
+                x = x.astype(np.float32)
+                _, last = sess.run([train_op, loss],
+                                   feed_dict={images: x, labels: y})
+                if step % 50 == 0 and hvd.rank() == 0:
+                    print(f"step {step} loss {last:.4f}", flush=True)
+    if hvd.rank() == 0:
+        print(f"final loss {last:.4f}", flush=True)
+        assert last < 1.0, last
+    hvd.shutdown()
+
+
+if __name__ == "__main__":
+    main()

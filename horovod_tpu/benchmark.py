@@ -30,7 +30,7 @@ class BenchResult:
     device_kind: str = "unknown"
     flops_per_step: Optional[float] = None
     mfu: Optional[float] = None
-    stem: str = "conv"
+    stem: Optional[str] = "conv"   # None: model has no stem knob
 
 
 # Peak dense bf16 FLOP/s per chip by device kind (public spec-sheet numbers;
@@ -91,7 +91,7 @@ class _Rig:
         from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
         import horovod_tpu as hvd
-        from .models import ResNet50, ResNet18
+        from .models import InceptionV3, ResNet18, ResNet50, ResNet101, VGG16
 
         if not hvd.is_initialized():
             hvd.init()
@@ -112,9 +112,27 @@ class _Rig:
         # SpaceToDepthStem); numerics-tested equal, so using it is a
         # layout optimization, not a model change. Per-stage override >
         # env knob > canonical conv.
-        self.stem = stem or os.environ.get("HVD_TPU_BENCH_STEM", "conv")
-        model = {"resnet50": ResNet50, "resnet18": ResNet18}[model_name](
-            num_classes=1000, stem=self.stem)
+        # the stem knob exists only on the ResNet family; a stem-less
+        # model records None so results never claim an A/B that did not
+        # happen and the ladder never rebuilds over a no-op stem change
+        self.stem = (stem or os.environ.get("HVD_TPU_BENCH_STEM", "conv")) \
+            if model_name.startswith("resnet") else None
+        # the benchmark trio of the reference's scaling table
+        # (docs/benchmarks.rst:13-14): ResNet, VGG (dropout off for a
+        # deterministic throughput workload; BN-free, exercising the
+        # no-batch-stats path)
+        builders = {
+            "resnet18": lambda: ResNet18(num_classes=1000, stem=self.stem),
+            "resnet50": lambda: ResNet50(num_classes=1000, stem=self.stem),
+            "resnet101": lambda: ResNet101(num_classes=1000,
+                                           stem=self.stem),
+            "vgg16": lambda: VGG16(num_classes=1000, dropout_rate=0.0),
+            # tf_cnn_benchmarks' name for it; canonical input is 299px
+            # but any size >= 75 runs
+            "inception3": lambda: InceptionV3(num_classes=1000,
+                                              dropout_rate=0.0),
+        }
+        model = builders[model_name]()
 
         rng = jax.random.PRNGKey(0)
         self.images = jax.device_put(
@@ -129,7 +147,9 @@ class _Rig:
                                          jnp.bfloat16), train=True),
             out_shardings=replicated)()
         self.params = variables["params"]
-        self.batch_stats = variables["batch_stats"]
+        # BN-free models (VGG) have no batch_stats collection
+        self._has_bn = "batch_stats" in variables
+        self.batch_stats = variables.get("batch_stats", {})
 
         # LR scaled by device count, the reference's hvd.size() recipe
         # (examples/tensorflow2_synthetic_benchmark.py lr * hvd.size())
@@ -139,13 +159,19 @@ class _Rig:
         self.opt_state = jax.jit(opt.init, out_shardings=replicated)(
             self.params)
 
+        has_bn = self._has_bn
+
         def loss_fn(p, bs, x, y):
-            logits, updates = model.apply(
-                {"params": p, "batch_stats": bs}, x, train=True,
-                mutable=["batch_stats"])
+            if has_bn:
+                logits, updates = model.apply(
+                    {"params": p, "batch_stats": bs}, x, train=True,
+                    mutable=["batch_stats"])
+                bs = updates["batch_stats"]
+            else:
+                logits = model.apply({"params": p}, x, train=True)
             loss = optax.softmax_cross_entropy_with_integer_labels(
                 logits, y).mean()
-            return loss, updates["batch_stats"]
+            return loss, bs
 
         def _step(p, bs, s, x, y):
             (loss, bs), grads = jax.value_and_grad(
@@ -180,7 +206,11 @@ class _Rig:
         self.flops_per_step = _compiled_flops(
             self.train_step, self.params, self.batch_stats, self.opt_state,
             self.images, self.labels)
-        if self.flops_per_step is None:
+        if self.flops_per_step is None and model_name == "resnet50" \
+                and image_size == 224:
+            # the analytic constant is for ResNet-50 @ 224 only; other
+            # models without XLA cost analysis report no flops (and so
+            # no MFU) rather than a number borrowed from the wrong model
             self.flops_per_step = (
                 _RESNET50_TRAIN_FLOPS_PER_IMAGE * global_batch)
 
@@ -305,8 +335,9 @@ def synthetic_resnet50_ladder(stages, image_size: int = 224,
         # the SAME resolution _Rig applies — so a default stage after a
         # stem-overridden one correctly rebuilds instead of silently
         # measuring the previous stage's stem
-        want_stem = st.get("stem") or os.environ.get(
-            "HVD_TPU_BENCH_STEM", "conv")
+        want_stem = (st.get("stem") or os.environ.get(
+            "HVD_TPU_BENCH_STEM", "conv")) \
+            if model_name.startswith("resnet") else None
         try:
             if rig is None or rig.batch_per_chip != b \
                     or want_stem != rig.stem:

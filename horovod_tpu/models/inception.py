@@ -1,0 +1,184 @@
+"""Inception V3 (Szegedy et al. 2015) — flax, TPU-first.
+
+The first network of the reference's headline scaling table
+(/root/reference/docs/benchmarks.rst:13-14: 90% scaling efficiency at
+512 GPUs). Faithful to the canonical tf-slim topology (stem, 3x
+InceptionA, InceptionB, 4x InceptionC, InceptionD, 2x InceptionE,
+~23.8M params at 1000 classes); the auxiliary classifier head is
+optional and off by default — it exists for training regularization and
+contributes nothing to a throughput benchmark. TPU-first choices:
+bfloat16 conv compute with fp32 params and fp32 BatchNorm statistics,
+fp32 classifier head, branch widths that keep channel dims MXU-friendly.
+"""
+
+from functools import partial
+from typing import Any, Tuple
+
+import jax.numpy as jnp
+from flax import linen as nn
+
+
+class ConvBN(nn.Module):
+    """conv -> BN -> relu, the inception building unit."""
+
+    features: int
+    kernel: Tuple[int, int] = (1, 1)
+    strides: Tuple[int, int] = (1, 1)
+    padding: str = "SAME"
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        x = nn.Conv(self.features, self.kernel, strides=self.strides,
+                    padding=self.padding, use_bias=False,
+                    dtype=self.dtype, param_dtype=jnp.float32)(x)
+        x = nn.BatchNorm(use_running_average=not train, momentum=0.9,
+                         epsilon=1e-3, dtype=self.dtype,
+                         param_dtype=jnp.float32)(x)
+        return nn.relu(x)
+
+
+def _avg_pool_same(x):
+    return nn.avg_pool(x, (3, 3), strides=(1, 1), padding="SAME")
+
+
+class InceptionA(nn.Module):
+    pool_features: int
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        cb = partial(ConvBN, dtype=self.dtype)
+        b1 = cb(64)(x, train)
+        b2 = cb(48)(x, train)
+        b2 = cb(64, (5, 5))(b2, train)
+        b3 = cb(64)(x, train)
+        b3 = cb(96, (3, 3))(b3, train)
+        b3 = cb(96, (3, 3))(b3, train)
+        b4 = cb(self.pool_features)(_avg_pool_same(x), train)
+        return jnp.concatenate([b1, b2, b3, b4], axis=-1)
+
+
+class InceptionB(nn.Module):
+    """Grid reduction 35x35 -> 17x17."""
+
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        cb = partial(ConvBN, dtype=self.dtype)
+        b1 = cb(384, (3, 3), strides=(2, 2), padding="VALID")(x, train)
+        b2 = cb(64)(x, train)
+        b2 = cb(96, (3, 3))(b2, train)
+        b2 = cb(96, (3, 3), strides=(2, 2), padding="VALID")(b2, train)
+        b3 = nn.max_pool(x, (3, 3), strides=(2, 2))
+        return jnp.concatenate([b1, b2, b3], axis=-1)
+
+
+class InceptionC(nn.Module):
+    """Factorized 7x7 branches."""
+
+    channels_7x7: int
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        cb = partial(ConvBN, dtype=self.dtype)
+        c7 = self.channels_7x7
+        b1 = cb(192)(x, train)
+        b2 = cb(c7)(x, train)
+        b2 = cb(c7, (1, 7))(b2, train)
+        b2 = cb(192, (7, 1))(b2, train)
+        b3 = cb(c7)(x, train)
+        b3 = cb(c7, (7, 1))(b3, train)
+        b3 = cb(c7, (1, 7))(b3, train)
+        b3 = cb(c7, (7, 1))(b3, train)
+        b3 = cb(192, (1, 7))(b3, train)
+        b4 = cb(192)(_avg_pool_same(x), train)
+        return jnp.concatenate([b1, b2, b3, b4], axis=-1)
+
+
+class InceptionD(nn.Module):
+    """Grid reduction 17x17 -> 8x8."""
+
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        cb = partial(ConvBN, dtype=self.dtype)
+        b1 = cb(192)(x, train)
+        b1 = cb(320, (3, 3), strides=(2, 2), padding="VALID")(b1, train)
+        b2 = cb(192)(x, train)
+        b2 = cb(192, (1, 7))(b2, train)
+        b2 = cb(192, (7, 1))(b2, train)
+        b2 = cb(192, (3, 3), strides=(2, 2), padding="VALID")(b2, train)
+        b3 = nn.max_pool(x, (3, 3), strides=(2, 2))
+        return jnp.concatenate([b1, b2, b3], axis=-1)
+
+
+class InceptionE(nn.Module):
+    """Expanded-filter-bank blocks (split 3x3s concatenated)."""
+
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        cb = partial(ConvBN, dtype=self.dtype)
+        b1 = cb(320)(x, train)
+        b2 = cb(384)(x, train)
+        b2 = jnp.concatenate([cb(384, (1, 3))(b2, train),
+                              cb(384, (3, 1))(b2, train)], axis=-1)
+        b3 = cb(448)(x, train)
+        b3 = cb(384, (3, 3))(b3, train)
+        b3 = jnp.concatenate([cb(384, (1, 3))(b3, train),
+                              cb(384, (3, 1))(b3, train)], axis=-1)
+        b4 = cb(192)(_avg_pool_same(x), train)
+        return jnp.concatenate([b1, b2, b3, b4], axis=-1)
+
+
+class InceptionV3(nn.Module):
+    num_classes: int = 1000
+    dtype: Any = jnp.bfloat16
+    aux_logits: bool = False
+    dropout_rate: float = 0.5
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        cb = partial(ConvBN, dtype=self.dtype)
+        x = x.astype(self.dtype)
+        # stem: 299x299x3 -> 35x35x192
+        x = cb(32, (3, 3), strides=(2, 2), padding="VALID")(x, train)
+        x = cb(32, (3, 3), padding="VALID")(x, train)
+        x = cb(64, (3, 3))(x, train)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2))
+        x = cb(80, (1, 1), padding="VALID")(x, train)
+        x = cb(192, (3, 3), padding="VALID")(x, train)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2))
+        # 35x35
+        x = InceptionA(32, dtype=self.dtype)(x, train)
+        x = InceptionA(64, dtype=self.dtype)(x, train)
+        x = InceptionA(64, dtype=self.dtype)(x, train)
+        x = InceptionB(dtype=self.dtype)(x, train)
+        # 17x17
+        x = InceptionC(128, dtype=self.dtype)(x, train)
+        x = InceptionC(160, dtype=self.dtype)(x, train)
+        x = InceptionC(160, dtype=self.dtype)(x, train)
+        x = InceptionC(192, dtype=self.dtype)(x, train)
+        aux = None
+        if self.aux_logits:
+            a = nn.avg_pool(x, (5, 5), strides=(3, 3), padding="VALID")
+            a = cb(128)(a, train)
+            a = cb(768, tuple(a.shape[1:3]), padding="VALID")(a, train)
+            a = a.reshape((a.shape[0], -1)).astype(jnp.float32)
+            aux = nn.Dense(self.num_classes, dtype=jnp.float32,
+                           param_dtype=jnp.float32, name="aux_head")(a)
+        x = InceptionD(dtype=self.dtype)(x, train)
+        # 8x8
+        x = InceptionE(dtype=self.dtype)(x, train)
+        x = InceptionE(dtype=self.dtype)(x, train)
+        # head: global average pool, dropout, fp32 classifier
+        x = x.mean(axis=(1, 2))
+        x = nn.Dropout(self.dropout_rate, deterministic=not train)(x)
+        x = nn.Dense(self.num_classes, dtype=jnp.float32,
+                     param_dtype=jnp.float32)(x.astype(jnp.float32))
+        return (x, aux) if self.aux_logits else x

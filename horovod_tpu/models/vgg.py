@@ -1,0 +1,60 @@
+"""VGG (Simonyan & Zisserman 2014) — flax, TPU-first.
+
+One of the reference's three headline scaling-benchmark networks
+(/root/reference/docs/benchmarks.rst:13-14 reports 68% scaling
+efficiency for VGG-16 at 512 GPUs — the hardest of the trio because its
+~138M params are dominated by the fc layers, making it allreduce-bound;
+that property is exactly why it belongs in a collective-framework's
+model zoo). TPU-first choices: bfloat16 conv/matmul compute with fp32
+params, channel counts that tile onto the 128x128 MXU, no BatchNorm
+(classic VGG), fp32 classifier head.
+"""
+
+from functools import partial
+from typing import Any, Sequence
+
+import jax.numpy as jnp
+from flax import linen as nn
+
+# layers per stage (convs between maxpools), classic configurations
+_CFG = {
+    "vgg11": (1, 1, 2, 2, 2),
+    "vgg13": (2, 2, 2, 2, 2),
+    "vgg16": (2, 2, 3, 3, 3),
+    "vgg19": (2, 2, 4, 4, 4),
+}
+_WIDTHS = (64, 128, 256, 512, 512)
+
+
+class VGG(nn.Module):
+    """Configurable VGG; ``stage_sizes`` counts 3x3 convs per stage."""
+
+    stage_sizes: Sequence[int]
+    num_classes: int = 1000
+    dtype: Any = jnp.bfloat16
+    classifier_width: int = 4096
+    dropout_rate: float = 0.5
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        conv = partial(nn.Conv, kernel_size=(3, 3), padding="SAME",
+                       dtype=self.dtype, param_dtype=jnp.float32)
+        x = x.astype(self.dtype)
+        for width, reps in zip(_WIDTHS, self.stage_sizes):
+            for _ in range(reps):
+                x = nn.relu(conv(width)(x))
+            x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = x.reshape((x.shape[0], -1))
+        for _ in range(2):
+            x = nn.relu(nn.Dense(self.classifier_width, dtype=self.dtype,
+                                 param_dtype=jnp.float32)(x))
+            x = nn.Dropout(self.dropout_rate, deterministic=not train)(x)
+        # fp32 head, like the ResNet zoo (logit accuracy)
+        return nn.Dense(self.num_classes, dtype=jnp.float32,
+                        param_dtype=jnp.float32)(x)
+
+
+VGG11 = partial(VGG, stage_sizes=_CFG["vgg11"])
+VGG13 = partial(VGG, stage_sizes=_CFG["vgg13"])
+VGG16 = partial(VGG, stage_sizes=_CFG["vgg16"])
+VGG19 = partial(VGG, stage_sizes=_CFG["vgg19"])

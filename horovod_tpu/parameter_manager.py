@@ -19,8 +19,15 @@ Protocol (reference parameter_manager.cc Update/Tune):
 * each scored sample feeds the GP/EI optimizer (native csrc/bo.cc, with a
   deterministic golden-section-style Python fallback), which proposes the
   next threshold;
-* after ``HVD_TPU_AUTOTUNE_BAYES_OPT_MAX_SAMPLES`` samples tuning stops on
-  the best threshold seen.
+* after ``HVD_TPU_AUTOTUNE_BAYES_OPT_MAX_SAMPLES`` samples the knob locks
+  on the best value seen and tuning moves to the next knob (coordinate
+  descent over the 1-D optimizer — the reference tunes its multi-knob set
+  jointly, but its cycle-time/hierarchy knobs don't exist here);
+* knobs, in order: the fusion threshold (bucket size), then the
+  host-packing cutoff (``HVD_TPU_PACK_CUTOFF``, the hybrid fusion
+  buffer's pack-vs-solo member boundary). Each phase re-runs warmup
+  (changed cutoffs change program structure, so fresh compiles pollute
+  the first sample).
 
 Cross-process agreement (reference: rank 0 tunes and broadcasts,
 controller.cc:33-47 SynchronizeParameters): local throughput measurements
@@ -38,8 +45,14 @@ from typing import Optional
 from . import config as _config
 from ._native import get as _native_get
 
-# Search space: log2(threshold bytes) in [1 MB, 256 MB].
-_LOG2_LO, _LOG2_HI = 20.0, 28.0
+# Tuned knobs in phase order: (config name, log2 lo, log2 hi).
+# Fusion threshold searches [1 MB, 256 MB]; pack cutoff [4 KB, 4 MB].
+_KNOBS = (
+    ("FUSION_THRESHOLD", 20.0, 28.0),
+    ("PACK_CUTOFF", 12.0, 22.0),
+)
+# kept for existing callers/tests of the fallback optimizer
+_LOG2_LO, _LOG2_HI = _KNOBS[0][1], _KNOBS[0][2]
 
 
 class _PythonFallbackOptimizer:
@@ -48,10 +61,12 @@ class _PythonFallbackOptimizer:
     Same interface as the native BO (observe/suggest), same determinism
     property (identical history -> identical suggestion)."""
 
-    _GRID = [20.0, 22.0, 24.0, 26.0, 28.0]
-
     def __init__(self, lo: float, hi: float):
         self._lo, self._hi = lo, hi
+        # 5-point grid over THIS knob's bounds (a class-level grid baked
+        # to the fusion-threshold range sent the PACK_CUTOFF phase
+        # probing 64-256 MB cutoffs — round-5 review finding)
+        self._GRID = [lo + i * (hi - lo) / 4.0 for i in range(5)]
         self._obs = []
 
     def observe(self, x: float, y: float):
@@ -98,24 +113,39 @@ class ParameterManager:
     def __init__(self, world):
         cfg = world.config
         self._world = world
-        self._warmup_left = int(cfg.get(_config.AUTOTUNE_WARMUP_SAMPLES))
+        self._warmup_samples = int(
+            cfg.get(_config.AUTOTUNE_WARMUP_SAMPLES))
+        self._warmup_left = self._warmup_samples
         self._steps_per_sample = max(
             1, int(cfg.get(_config.AUTOTUNE_STEPS_PER_SAMPLE)))
         self._max_samples = int(
             cfg.get(_config.AUTOTUNE_BAYES_OPT_MAX_SAMPLES))
         self._log_path = cfg.get(_config.AUTOTUNE_LOG)
-        nat = _native_get()
-        if nat is not None:
-            self._opt = _NativeOptimizer(nat, _LOG2_LO, _LOG2_HI)
-        else:
-            self._opt = _PythonFallbackOptimizer(_LOG2_LO, _LOG2_HI)
-        self._threshold = int(cfg.get(_config.FUSION_THRESHOLD))
-        self._best = (self._threshold, -1.0)
+        self._nat = _native_get()
+        self._values = {name: int(cfg.get(getattr(_config, name)))
+                        for name, _lo, _hi in _KNOBS}
+        self._phase = 0
         self._samples_done = 0
         self._step_in_sample = 0
         self._bytes_acc = 0
         self._time_acc = 0.0
         self._finished = False
+        self._enter_phase(0)
+
+    def _enter_phase(self, phase: int) -> None:
+        self._phase = phase
+        name, lo, hi = _KNOBS[phase]
+        if self._nat is not None:
+            self._opt = _NativeOptimizer(self._nat, lo, hi)
+        else:
+            self._opt = _PythonFallbackOptimizer(lo, hi)
+        self._best = (self._values[name], -1.0)
+        self._samples_done = 0
+        self._warmup_left = self._warmup_samples
+
+    @property
+    def _knob_name(self) -> str:
+        return _KNOBS[self._phase][0]
 
     # -- interface consulted by the reduction path ---------------------------
     @property
@@ -124,7 +154,7 @@ class ParameterManager:
 
     @property
     def fusion_threshold(self) -> int:
-        return self._threshold
+        return self._values["FUSION_THRESHOLD"]
 
     def record(self, nbytes: int, seconds: float) -> None:
         """Report one eager reduction step's traffic and wall time."""
@@ -141,30 +171,42 @@ class ParameterManager:
         self._time_acc = 0.0
         if self._warmup_left > 0:
             self._warmup_left -= 1
-            self._log(f"warmup threshold={self._threshold} "
+            self._log(f"warmup {self._knob_name}="
+                      f"{self._values[self._knob_name]} "
                       f"score={score:.3e} (discarded)")
             return
         self._observe_and_advance(score)
 
     def _observe_and_advance(self, score: float) -> None:
-        x = math.log2(max(self._threshold, 1))
+        name = self._knob_name
+        value = self._values[name]
+        x = math.log2(max(value, 1))
         if score > self._best[1]:
-            self._best = (self._threshold, score)
+            self._best = (value, score)
         self._samples_done += 1
-        self._log(f"sample {self._samples_done} threshold={self._threshold} "
+        self._log(f"sample {self._samples_done} {name}={value} "
                   f"score={score:.3e} bytes/sec")
         if self._samples_done >= self._max_samples:
             # per-process best scores differ; rank 0's pick is adopted
             # everywhere, like every other proposal
-            self._threshold = int(self._sync(float(self._best[0])))
-            self._finished = True
-            self._log(f"tuning complete: threshold={self._threshold} "
-                      f"score={self._best[1]:.3e}")
-        else:
-            self._opt.observe(x, score)
-            proposal = 1 << int(round(self._sync(self._opt.suggest())))
-            self._threshold = proposal
-        self._world.config.set("FUSION_THRESHOLD", self._threshold)
+            self._values[name] = int(self._sync(float(self._best[0])))
+            self._world.config.set(name, self._values[name])
+            if self._phase + 1 < len(_KNOBS):
+                self._log(f"knob locked: {name}={self._values[name]} "
+                          f"score={self._best[1]:.3e}; tuning "
+                          f"{_KNOBS[self._phase + 1][0]} next")
+                self._enter_phase(self._phase + 1)
+            else:
+                self._finished = True
+                summary = " ".join(
+                    f"{n}={self._values[n]}" for n, _l, _h in _KNOBS)
+                self._log(f"tuning complete: {summary} "
+                          f"score={self._best[1]:.3e}")
+            return
+        self._opt.observe(x, score)
+        proposal = 1 << int(round(self._sync(self._opt.suggest())))
+        self._values[name] = proposal
+        self._world.config.set(name, self._values[name])
 
     def _sync(self, proposal: float) -> float:
         """Adopt rank 0's proposal in a multi-process world (reference:

@@ -43,23 +43,38 @@ def test_parameter_manager_schedule(autotune_world):
     pm.record(1 << 20, 0.01)
     pm.record(1 << 20, 0.01)
     assert pm.fusion_threshold == start_threshold
-    # 4 scored samples complete tuning
+    # 4 scored samples lock the fusion threshold; tuning then moves to
+    # the pack cutoff (round-5 coordinate descent), so the manager stays
+    # active
     for s in range(4):
         assert pm.active
         pm.record(1 << 20, 0.01 + 0.001 * s)
         pm.record(1 << 20, 0.01 + 0.001 * s)
-    assert not pm.active
+    assert pm.active
     t = pm.fusion_threshold
     assert (1 << 20) <= t <= (1 << 28)
     assert t & (t - 1) == 0  # power of two
     # knob propagated to config for later consumers
     assert w.config.get(_config.FUSION_THRESHOLD) == t
+    # phase 2: warmup + 4 samples tune PACK_CUTOFF, then tuning finishes
+    pm.record(1 << 20, 0.01)
+    pm.record(1 << 20, 0.01)  # phase-2 warmup sample
+    for s in range(4):
+        assert pm.active
+        pm.record(1 << 20, 0.01 + 0.001 * s)
+        pm.record(1 << 20, 0.01 + 0.001 * s)
+    assert not pm.active
+    assert pm.fusion_threshold == t  # locked knob untouched by phase 2
+    c = w.config.get(_config.PACK_CUTOFF)
+    assert (1 << 12) <= c <= (1 << 22)
+    assert c & (c - 1) == 0
     # further records are no-ops
     pm.record(1, 1.0)
     assert pm.fusion_threshold == t
     with open(autotune_world) as f:
         log = f.read()
-    assert "warmup" in log and "tuning complete" in log
+    assert "warmup" in log and "knob locked" in log
+    assert "tuning complete" in log
 
 
 def test_autotune_through_optimizer(autotune_world):
@@ -73,8 +88,8 @@ def test_autotune_through_optimizer(autotune_world):
     pm = basics.world().parameter_manager
     grads = {"w": np.full((4, 4), 2.0, np.float32),
              "b": np.full(4, 2.0, np.float32)}
-    # (1 warmup + 4 samples) x 2 steps/sample = 10 steps to converge
-    for _ in range(10):
+    # two phases x (1 warmup + 4 samples) x 2 steps/sample = 20 steps
+    for _ in range(20):
         updates, state = opt.update(grads, state, params)
     assert not pm.active
     # size-1 world: averaged grad == grad; sgd update = -0.1*grad

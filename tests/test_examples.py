@@ -46,6 +46,7 @@ def _run_example(script, *args, timeout=420, devices=8):
     ("adasum_small_model.py", ()),
     ("torch_synthetic_benchmark.py", ("--num-iters", "2")),
     ("tensorflow2_mnist.py", ("--steps", "30")),
+    ("tensorflow1_mnist.py", ("--steps", "60")),
     ("elastic/torch_mnist_elastic.py", ("--epochs", "1")),
 ])
 def test_example_runs(script, args):

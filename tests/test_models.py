@@ -159,3 +159,96 @@ def test_space_to_depth_stem_matches_conv_stem():
                       jax.tree_util.tree_leaves(gb)):
         np.testing.assert_allclose(np.asarray(la), np.asarray(lb),
                                    rtol=2e-4, atol=2e-4)
+
+
+class TestVGG:
+    """VGG-16 — the third network of the reference's headline scaling
+    table (docs/benchmarks.rst:13-14; allreduce-bound: fc-dominated
+    ~138M params)."""
+
+    def test_vgg16_forward_shapes_and_dtype(self):
+        import jax
+        import jax.numpy as jnp
+        from horovod_tpu.models import VGG16
+        model = VGG16(num_classes=10, classifier_width=64,
+                      dropout_rate=0.0)
+        x = jnp.zeros((2, 32, 32, 3), jnp.bfloat16)
+        v = model.init(jax.random.PRNGKey(0), x, train=False)
+        assert "batch_stats" not in v  # classic VGG: no BN
+        out = model.apply(v, x, train=False)
+        assert out.shape == (2, 10)
+        assert out.dtype == jnp.float32  # fp32 head
+
+    def test_vgg16_param_count_full_size(self):
+        import jax
+        import jax.numpy as jnp
+        from horovod_tpu.models import VGG16
+        model = VGG16(num_classes=1000)
+        v = jax.eval_shape(
+            lambda: model.init(jax.random.PRNGKey(0),
+                               jnp.zeros((1, 224, 224, 3), jnp.bfloat16),
+                               train=False))
+        n = sum(int(np.prod(l.shape))
+                for l in jax.tree_util.tree_leaves(v["params"]))
+        assert abs(n - 138_357_544) < 1_000_000, n  # canonical ~138.36M
+
+    def test_vgg16_trains_through_benchmark_rig(self):
+        from horovod_tpu.benchmark import synthetic_resnet50_benchmark
+        r = synthetic_resnet50_benchmark(
+            batch_per_chip=2, image_size=32, model_name="vgg16",
+            num_warmup_batches=1, num_batches_per_iter=1, num_iters=1)
+        assert r.images_per_sec_total > 0
+
+    def test_vgg16_dropout_active_in_train(self):
+        import jax
+        import jax.numpy as jnp
+        from horovod_tpu.models import VGG16
+        model = VGG16(num_classes=10, classifier_width=64,
+                      dropout_rate=0.5)
+        x = jnp.ones((2, 32, 32, 3), jnp.bfloat16)
+        v = model.init(jax.random.PRNGKey(0), x, train=False)
+        a = model.apply(v, x, train=True,
+                        rngs={"dropout": jax.random.PRNGKey(1)})
+        b = model.apply(v, x, train=True,
+                        rngs={"dropout": jax.random.PRNGKey(2)})
+        assert not np.allclose(np.asarray(a), np.asarray(b))
+        # eval is deterministic
+        c = model.apply(v, x, train=False)
+        d = model.apply(v, x, train=False)
+        np.testing.assert_allclose(np.asarray(c), np.asarray(d))
+
+
+class TestInceptionV3:
+    """Inception V3 — completes the reference's scaling-table trio
+    (docs/benchmarks.rst:13-14: Inception V3 / ResNet-101 / VGG-16)."""
+
+    def test_param_count_matches_canonical(self):
+        import jax
+        import jax.numpy as jnp
+        from horovod_tpu.models import InceptionV3
+        m = InceptionV3(num_classes=1000, dropout_rate=0.0)
+        v = jax.eval_shape(
+            lambda: m.init(jax.random.PRNGKey(0),
+                           jnp.zeros((1, 299, 299, 3), jnp.bfloat16),
+                           train=False))
+        n = sum(int(np.prod(l.shape))
+                for l in jax.tree_util.tree_leaves(v["params"]))
+        assert n == 23_834_568, n  # torchvision inception_v3, no aux
+
+    def test_forward_and_aux_head(self):
+        import jax
+        import jax.numpy as jnp
+        from horovod_tpu.models import InceptionV3
+        m = InceptionV3(num_classes=7, dropout_rate=0.0, aux_logits=True)
+        x = jnp.zeros((2, 128, 128, 3), jnp.bfloat16)
+        v = m.init(jax.random.PRNGKey(0), x, train=False)
+        out, aux = m.apply(v, x, train=False)
+        assert out.shape == (2, 7) and aux.shape == (2, 7)
+        assert out.dtype == jnp.float32
+
+    def test_trains_through_benchmark_rig(self):
+        from horovod_tpu.benchmark import synthetic_resnet50_benchmark
+        r = synthetic_resnet50_benchmark(
+            batch_per_chip=2, image_size=96, model_name="inception3",
+            num_warmup_batches=1, num_batches_per_iter=1, num_iters=1)
+        assert r.images_per_sec_total > 0
