@@ -49,6 +49,17 @@ _TPU_PEAK_BF16_FLOPS = (
 _RESNET50_TRAIN_FLOPS_PER_IMAGE = 3 * 8.2e9
 
 
+def _resolve_stem(model_name: str, stem: Optional[str]) -> Optional[str]:
+    """The stem knob exists only on the ResNet family; resolution order
+    is per-stage override > env knob > canonical conv. Shared by _Rig and
+    the ladder so the ladder's rebuild check can never disagree with what
+    the rig actually built."""
+    import os
+    if not model_name.startswith("resnet"):
+        return None
+    return stem or os.environ.get("HVD_TPU_BENCH_STEM", "conv")
+
+
 def peak_flops_per_chip(device_kind: str) -> Optional[float]:
     k = (device_kind or "").lower()
     for name, peak in _TPU_PEAK_BF16_FLOPS:
@@ -107,16 +118,12 @@ class _Rig:
         batch_sharding = NamedSharding(mesh, P("dp"))
         replicated = NamedSharding(mesh, P())
 
-        import os
         # Math-equivalent MXU-friendly stem (models/resnet.py
         # SpaceToDepthStem); numerics-tested equal, so using it is a
-        # layout optimization, not a model change. Per-stage override >
-        # env knob > canonical conv.
-        # the stem knob exists only on the ResNet family; a stem-less
-        # model records None so results never claim an A/B that did not
-        # happen and the ladder never rebuilds over a no-op stem change
-        self.stem = (stem or os.environ.get("HVD_TPU_BENCH_STEM", "conv")) \
-            if model_name.startswith("resnet") else None
+        # layout optimization, not a model change. A stem-less model
+        # records None so results never claim an A/B that did not happen
+        # and the ladder never rebuilds over a no-op stem change.
+        self.stem = _resolve_stem(model_name, stem)
         # the benchmark trio of the reference's scaling table
         # (docs/benchmarks.rst:13-14): ResNet, VGG (dropout off for a
         # deterministic throughput workload; BN-free, exercising the
@@ -335,9 +342,7 @@ def synthetic_resnet50_ladder(stages, image_size: int = 224,
         # the SAME resolution _Rig applies — so a default stage after a
         # stem-overridden one correctly rebuilds instead of silently
         # measuring the previous stage's stem
-        want_stem = (st.get("stem") or os.environ.get(
-            "HVD_TPU_BENCH_STEM", "conv")) \
-            if model_name.startswith("resnet") else None
+        want_stem = _resolve_stem(model_name, st.get("stem"))
         try:
             if rig is None or rig.batch_per_chip != b \
                     or want_stem != rig.stem:
