@@ -988,29 +988,27 @@ def alltoall_async(tensor, splits=None, name: Optional[str] = None,
         maxs = int(split_tbl.max())
         rest = local.shape[1:]
         dt = _dtype_str(local.dtype)
-        if device_path:
-            # uniform splits: packing is a reshape plus (when a ragged
-            # peer forces maxs > s) a pad — keyed by shapes only, so the
-            # cache grows like every other verb's
+        # the on-device pack requires maxs == my split (a fully uniform
+        # WORLD): a ragged peer makes maxs per-call data, and a program
+        # keyed on it would recompile every step — that corner drops to
+        # the numpy pack below (the pre-round-5 behavior)
+        if device_path and maxs == splits[0]:
             s0 = splits[0]
 
             def build_pack():
-                def f(a):
-                    c = jnp.reshape(a, (nproc, s0) + tuple(rest))
-                    if maxs > s0:
-                        c = jnp.pad(c, [(0, 0), (0, maxs - s0)]
-                                    + [(0, 0)] * len(rest))
-                    return c
+                def f(a):  # uniform: packing is a pure reshape
+                    return jnp.reshape(a, (nproc, s0) + tuple(rest))
                 return jax.jit(f)
             chunks = _get_program(
-                w, ("a2a_pack", tuple(local.shape), s0, maxs, dt),
+                w, ("a2a_pack", tuple(local.shape), s0, dt),
                 build_pack)(local)
         else:
             # pad each outgoing chunk to maxs rows: (nproc, maxs, rest)
-            chunks = np.zeros((nproc, maxs) + rest, dtype=local.dtype)
+            src = np.asarray(local)  # one readback if device-resident
+            chunks = np.zeros((nproc, maxs) + rest, dtype=src.dtype)
             off = 0
             for j, s in enumerate(splits):
-                chunks[j, :s] = local[off:off + s]
+                chunks[j, :s] = src[off:off + s]
                 off += s
         garr = _global_from_local(wm, chunks)  # (src, dst, maxs, *rest)
 
@@ -1025,11 +1023,13 @@ def alltoall_async(tensor, splits=None, name: Optional[str] = None,
         # my shard: (1, src, maxs, *rest) — rows every src sent to me
         incoming = tuple(int(split_tbl[src, wm.my_index])
                          for src in range(nproc))
-        # device unpack only when every sender was uniform too (incoming
-        # all maxs): then it is a pure shape-keyed reshape. Ragged peers
-        # make `incoming` per-call data — jitting on it would recompile
-        # every call — so that corner reads back through numpy.
-        if device_path and all(i == maxs for i in incoming):
+        # device unpack only in the fully uniform world (my split == maxs
+        # AND every sender's too): then it is a pure shape-keyed reshape.
+        # Ragged peers make `incoming`/`maxs` per-call data — jitting on
+        # them would recompile every call — so that corner reads back
+        # through numpy.
+        if device_path and maxs == splits[0] \
+                and all(i == maxs for i in incoming):
             mine = _local_result(fn(garr))  # device array
 
             def build_unpack():

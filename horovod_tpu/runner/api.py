@@ -33,8 +33,10 @@ def run(fn, args=(), kwargs=None, np: int = 1,
     """Execute ``fn(*args, **kwargs)`` on ``np`` workers; return the list of
     per-rank return values ordered by rank (reference horovod.run()).
 
-    ``use_mpi`` is accepted for API parity and ignored: the TPU data plane is
-    XLA collectives, there is no MPI backend to select.
+    ``use_mpi`` selects the mpirun process launcher (reference
+    horovod.run(use_mpi=True)); the data plane is XLA either way —
+    workers launched by mpirun recover rank identity from the MPI env
+    and fetch the function through the same KV rendezvous.
     """
     host_list = parse_hosts(hosts) if hosts else [HostInfo("localhost", np)]
     if not disable_ssh_check:
@@ -55,11 +57,37 @@ def run(fn, args=(), kwargs=None, np: int = 1,
         coordinator_addr = f"{coord_host}:{free_port()}"
         rdv_host = "127.0.0.1" if all_local else _socket.gethostname()
         command = [sys.executable, "-m", "horovod_tpu.runner.run_task"]
-        codes = launch_workers(
-            command, slots, coordinator_addr,
-            rendezvous_addr=rdv_host,
-            rendezvous_port=server.port,
-            prefix_output=verbose, base_env=env)
+        if use_mpi:
+            import os
+
+            from .mpi_run import MPISettings, mpi_run
+            hosts_str = ",".join(
+                f"{h.hostname}:{h.slots}" for h in host_list)
+            # same base-env contract as the ssh launcher: an explicit
+            # ``env`` REPLACES the inherited environment (exec_run.py
+            # slot_env), it does not merge under it
+            worker_env = {**(env if env is not None else os.environ),
+                          "HVD_TPU_RENDEZVOUS_ADDR": rdv_host,
+                          "HVD_TPU_RENDEZVOUS_PORT": str(server.port)}
+            if all_local:
+                # the driver IS the coordinator host, so its free-port
+                # probe is valid; on remote host lists mpi_run derives a
+                # stable port on the FIRST host instead (its
+                # coordinator_addr_for — a local probe would test the
+                # wrong machine)
+                worker_env["HVD_TPU_COORDINATOR_ADDR"] = coordinator_addr
+            rc = mpi_run(
+                MPISettings(num_proc=size, hosts=hosts_str,
+                            verbose=verbose),
+                worker_env, command)
+            # mpirun yields one aggregate exit code for the whole gang
+            codes = [rc] * size
+        else:
+            codes = launch_workers(
+                command, slots, coordinator_addr,
+                rendezvous_addr=rdv_host,
+                rendezvous_port=server.port,
+                prefix_output=verbose, base_env=env)
         failed = [(r, c) for r, c in enumerate(codes) if c != 0]
         results = []
         for r in range(size):

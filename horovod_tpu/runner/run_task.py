@@ -34,7 +34,14 @@ def execute_from_store(rank: int):
 
 
 def main() -> int:
-    rank = int(os.environ.get("HVD_TPU_RANK", "0"))
+    rank_env = os.environ.get("HVD_TPU_RANK")
+    if rank_env is None:
+        # mpirun-launched workers (run(use_mpi=True)) carry identity in
+        # the MPI env family, not the launcher contract
+        from ..config import mpi_task_identity
+        rank = int(mpi_task_identity().get("RANK", 0))
+    else:
+        rank = int(rank_env)
     try:
         execute_from_store(rank)
         return 0

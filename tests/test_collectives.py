@@ -296,24 +296,16 @@ def test_joined_zero_substitution_preserves_residency(hvd_world):
     assert zd.shape == (2, 3)
 
 
-def test_alltoall_device_resident_uniform_stays_on_device(hvd_world):
-    """Uniform-split alltoall of a jax array packs/unpacks in
-    shape-keyed jitted programs instead of numpy copies (VERDICT r4 weak
-    #5 — the capacity-padded MoE shape). The device programs must
-    actually have been built (the host path returns jax arrays too, so
-    the cache key is the only observable difference), and ragged splits
-    must NOT take the device path (their programs would key on split
-    values and recompile every call)."""
-    from horovod_tpu.basics import world
-    from horovod_tpu.collectives import _jit_cache
+def test_alltoall_input_residency_numerics(hvd_world):
+    """alltoall numerics are identical for device (jax array) and host
+    (numpy) inputs, uniform or ragged. A size-1 world short-circuits
+    before the pack/unpack programs, so the on-device-path PROOF (jit
+    cache keys a2a_pack/a2a_unpack after a device-resident uniform call)
+    lives in tests/integration_worker.py over real processes."""
     x = jnp.arange(12, dtype=jnp.float32).reshape(12, 1) * 2
     out = hvd.alltoall(x, name="a2a.dev")
     assert isinstance(out, jax.Array)
     np.testing.assert_array_equal(np.asarray(out), np.asarray(x))
-    # size-1 world short-circuits before pack; exercise the program
-    # builders directly is overkill — instead assert the routing flag
-    # through the cache on a >1-member... at size 1 the dispatch returns
-    # early, so here we only pin numerics + the ragged fallback:
     y = jnp.arange(5, dtype=jnp.float32)
     out2 = hvd.alltoall(y, splits=[5], name="a2a.devragged")
     np.testing.assert_array_equal(np.asarray(out2), np.asarray(y))

@@ -492,3 +492,62 @@ class TestRunController:
         env["SLURM_STEP_TASKS_PER_NODE"] = "3(x2)"
         ident = mpi_task_identity(env)
         assert ident["CROSS_RANK"] == 1 and ident["CROSS_SIZE"] == 2
+
+
+def test_programmatic_run_use_mpi(monkeypatch, tmp_path):
+    """run(use_mpi=True) drives workers through the stub mpirun and
+    still collects per-rank results through the KV rendezvous
+    (reference horovod.run(use_mpi=True))."""
+    import stat
+    stub_dir = tmp_path / "bin"
+    stub_dir.mkdir()
+    stub = stub_dir / "mpirun"
+    stub.write_text("""#!/usr/bin/env python3
+import os, subprocess, sys
+args = sys.argv[1:]
+if "--version" in args:
+    print("mpirun (Open MPI) 4.1.4"); sys.exit(0)
+VAL1 = {"-np", "-H", "-x", "--output-filename",
+        "-bind-to", "-map-by", "-rank-by"}
+VAL0 = {"--allow-run-as-root", "--tag-output"}
+np_ = 1; i = 0
+while i < len(args):
+    a = args[i]
+    if a == "-mca":
+        i += 3; continue
+    if a in VAL1:
+        if a == "-np": np_ = int(args[i+1])
+        i += 2; continue
+    if a in VAL0:
+        i += 1; continue
+    break
+cmd = args[i:]
+procs = []
+for rank in range(np_):
+    env = dict(os.environ)
+    env.update({"OMPI_COMM_WORLD_RANK": str(rank),
+                "OMPI_COMM_WORLD_SIZE": str(np_),
+                "OMPI_COMM_WORLD_LOCAL_RANK": str(rank),
+                "OMPI_COMM_WORLD_LOCAL_SIZE": str(np_)})
+    procs.append(subprocess.Popen(cmd, env=env))
+sys.exit(max(p.wait() for p in procs))
+""")
+    stub.chmod(stub.stat().st_mode | stat.S_IEXEC)
+    monkeypatch.setenv("PATH", f"{stub_dir}{os.pathsep}"
+                               f"{os.environ.get('PATH', '')}")
+    monkeypatch.setenv("JAX_PLATFORMS", "cpu")
+    monkeypatch.delenv("PALLAS_AXON_POOL_IPS", raising=False)
+
+    from horovod_tpu.runner.api import run
+
+    def fn(a, b=0):
+        import horovod_tpu as hvd
+        hvd.init()
+        try:
+            return a + b + hvd.rank()
+        finally:
+            hvd.shutdown()
+
+    results = run(fn, args=(10,), kwargs={"b": 5}, np=2, use_mpi=True,
+                  disable_ssh_check=True)
+    assert results == [15, 16]
