@@ -190,12 +190,32 @@ def check_build() -> str:
 
     from .. import __version__
     from .._native import get as native_get
+    # the device query dials the accelerator runtime, which can HANG
+    # when a remote PJRT relay is down — a diagnostics command must
+    # answer anyway, so probe in a killable subprocess
     try:
-        import jax
-        backends = ",".join(sorted({d.platform for d in jax.devices()}))
+        penv = dict(os.environ)
+        if penv.get("JAX_PLATFORMS") == "cpu":
+            # an explicit CPU choice must not stall on an accelerator
+            # relay plugin that dials out at interpreter startup
+            penv.pop("PALLAS_AXON_POOL_IPS", None)
+        r = subprocess.run(
+            [sys.executable, "-c",
+             "import jax; print(','.join(sorted({d.platform "
+             "for d in jax.devices()})))"],
+            capture_output=True, text=True, timeout=25, env=penv)
+        backends = r.stdout.strip() if r.returncode == 0 and r.stdout.strip() \
+            else "unavailable"
+    except subprocess.TimeoutExpired:
+        backends = "unreachable (probe timed out)"
     except Exception:
         backends = "unavailable"
     native = "X" if native_get() is not None else " "
+    from .mpi_run import MISSING_IMPL, UNKNOWN_IMPL, get_mpi_implementation
+    mpi_impl = get_mpi_implementation()
+    mpi_mark = " " if mpi_impl in (MISSING_IMPL, UNKNOWN_IMPL) else "X"
+    if mpi_impl == MISSING_IMPL:
+        mpi_impl = "not installed"
     return f"""\
 horovod_tpu v{__version__}:
 
@@ -216,6 +236,7 @@ Native Core (C++):
 
 Launchers:
     [X] local / ssh
+    [{mpi_mark}] mpirun ({mpi_impl})
     [{'X' if shutil.which('jsrun') else ' '}] LSF jsrun"""
 
 

@@ -248,6 +248,15 @@ def mpi_run(settings: MPISettings, env: Dict[str, str],
                   "CROSS_RANK", "CROSS_SIZE"):
         env.pop(f"HVD_TPU_{stale}", None)
         env.pop(f"HOROVOD_{stale}", None)
+    # ... and the raw scheduler identity families the DRIVER may be
+    # running under (e.g. a SLURM batch step): locally spawned workers
+    # inherit the mpirun process env, and a driver-side SLURM_PROCID=0
+    # would out-rank the MPICH PMI family in config._MPI_FAMILIES, giving
+    # every worker rank 0. mpirun sets its own family on each worker.
+    from ..config import _MPI_FAMILIES
+    for fam in _MPI_FAMILIES:
+        for var in fam:
+            env.pop(var, None)
     env["HVD_TPU_SIZE"] = str(settings.num_proc)
     env.setdefault("HVD_TPU_COORDINATOR_ADDR",
                    coordinator_addr_for(settings.hosts))

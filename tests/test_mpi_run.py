@@ -551,3 +551,21 @@ sys.exit(max(p.wait() for p in procs))
     results = run(fn, args=(10,), kwargs={"b": 5}, np=2, use_mpi=True,
                   disable_ssh_check=True)
     assert results == [15, 16]
+
+
+def test_mpi_run_strips_driver_scheduler_identity():
+    """A driver running inside a SLURM/PMI step must not leak its own
+    identity vars into the mpirun process env — locally spawned workers
+    would resolve the DRIVER's rank (round-5 review finding)."""
+    captured = {}
+    mpi_run(basic_settings(),
+            {"SLURM_PROCID": "0", "SLURM_STEP_NUM_TASKS": "1",
+             "PMI_RANK": "0", "PMI_SIZE": "1",
+             "OMPI_COMM_WORLD_RANK": "0", "KEEPME": "1"},
+            ["c"], exec_fn=exec_returning(OMPI_OUT),
+            spawn_fn=lambda argv, env: captured.update(env=env) or 0)
+    env = captured["env"]
+    for var in ("SLURM_PROCID", "SLURM_STEP_NUM_TASKS", "PMI_RANK",
+                "PMI_SIZE", "OMPI_COMM_WORLD_RANK"):
+        assert var not in env, var
+    assert env["KEEPME"] == "1"
