@@ -102,16 +102,35 @@ def _jax():
 
 def _jit_cache(w) -> dict:
     if getattr(w, "_collective_jit_cache", None) is None:
-        w._collective_jit_cache = {}
+        import collections
+        w._collective_jit_cache = collections.OrderedDict()
     return w._collective_jit_cache
 
 
 def _get_program(w, key, builder):
+    """Compiled-program cache with an LRU bound.
+
+    Most keys derive from shapes/dtypes and stabilize quickly, but some
+    carry per-call data (ragged alltoallv's padded max), so a long run
+    with data-dependent patterns would otherwise grow the cache — and
+    the XLA executables it pins — without bound.
+    ``HVD_TPU_PROGRAM_CACHE_CAPACITY`` caps it (its own knob: the
+    response cache's CACHE_CAPACITY tunes a fingerprint table whose
+    ideal size is unrelated, and an eviction here costs a recompile). A
+    floor of 16 keeps tiny configurations from thrashing the handful of
+    programs every step uses; eviction order is LRU, identical on every
+    rank because the SPMD lockstep makes key streams identical.
+    """
     cache = _jit_cache(w)
     fn = cache.get(key)
     if fn is None:
         fn = builder()
         cache[key] = fn
+        cap = w.config.get(_config.PROGRAM_CACHE_CAPACITY)
+        if cap and len(cache) > max(int(cap), 16):
+            cache.popitem(last=False)
+    else:
+        cache.move_to_end(key)
     return fn
 
 

@@ -64,7 +64,14 @@ CYCLE_TIME = _register(
     help="Async-coordinator cycle time in milliseconds.")
 CACHE_CAPACITY = _register(
     "CACHE_CAPACITY", 1024, int, alias="HOROVOD_CACHE_CAPACITY",
-    help="Capacity of the fused-collective plan cache (0 disables).")
+    help="Capacity of the response cache (consistency-exchange "
+         "fingerprints; 0 disables, reference HOROVOD_CACHE_CAPACITY).")
+PROGRAM_CACHE_CAPACITY = _register(
+    "PROGRAM_CACHE_CAPACITY", 1024, int,
+    help="LRU bound on the compiled collective-program cache (floor 16; "
+         "0 = unbounded). Distinct from CACHE_CAPACITY: program entries "
+         "pin XLA executables and evictions cost a recompile on next "
+         "use, so the two caches want very different capacities.")
 
 # -- Logging / timeline (reference: HOROVOD_LOG_LEVEL, HOROVOD_TIMELINE,
 #    HOROVOD_TIMELINE_MARK_CYCLES, common.h:61-63) ---------------------------

@@ -312,3 +312,31 @@ def test_alltoall_input_residency_numerics(hvd_world):
     z = np.arange(6, dtype=np.float32)
     out3 = hvd.alltoall(z, splits=[6], name="a2a.host")
     np.testing.assert_array_equal(np.asarray(out3), z)
+
+
+def test_program_cache_lru_bound(hvd_world, monkeypatch):
+    """The compiled-program cache honors HVD_TPU_PROGRAM_CACHE_CAPACITY
+    as an LRU bound (floor 16): data-dependent key streams (ragged
+    alltoallv maxs) must not grow it — and the XLA executables it pins —
+    forever. Evicted programs rebuild correctly on reuse."""
+    import horovod_tpu as hvd2
+    hvd2.shutdown()
+    monkeypatch.setenv("HVD_TPU_PROGRAM_CACHE_CAPACITY", "4")  # floor 16
+    hvd2.init()
+    try:
+        from horovod_tpu.basics import world
+        from horovod_tpu.collectives import _jit_cache
+        cache = _jit_cache(world())
+        for n in range(1, 41):  # 40 distinct shapes -> 40 distinct keys
+            out = hvd2.allreduce(np.ones(n, np.float32), op=hvd2.Sum,
+                                 name=f"lru.{n}")
+            np.testing.assert_array_equal(np.asarray(out), np.ones(n))
+        # exactly at the floor: proves insertions DID flow through the
+        # bounded cache (a <= alone would pass vacuously on an empty one)
+        assert len(cache) == 16, len(cache)
+        # an evicted shape still computes correctly (rebuilds)
+        out = hvd2.allreduce(np.ones(1, np.float32), op=hvd2.Sum,
+                             name="lru.again")
+        np.testing.assert_array_equal(np.asarray(out), np.ones(1))
+    finally:
+        hvd2.shutdown()
