@@ -56,6 +56,7 @@ class EstimatorParams:
         "label_cols", "output_cols", "batch_size", "epochs",
         "validation", "sample_weight_col", "num_proc", "store", "run_id",
         "verbose", "shuffle", "random_seed", "streaming",
+        "row_group_rows",
     ]
 
     def __init__(self, **kwargs):
@@ -84,6 +85,10 @@ class EstimatorParams:
         #: materializing the shard in memory (the Petastorm reader role;
         #: datasets larger than worker RAM). Torch estimator only.
         self.streaming = False
+        #: Parquet row-group size for the materialized dataset — the
+        #: streaming reader's memory/shuffle granularity (smaller groups
+        #: = finer shuffling and lower worker memory, more IO calls)
+        self.row_group_rows = 4096
         for k, v in kwargs.items():
             if k not in self._param_names:
                 raise TypeError(f"unknown estimator param {k!r}")
@@ -172,7 +177,8 @@ class HorovodEstimator(EstimatorParams):
                         else df[c].to_numpy() for c in cols}
             else:
                 data = {c: np.asarray(df[c]) for c in cols}
-            write_parquet(path, data, fs=fs)
+            write_parquet(path, data, fs=fs,
+                          row_group_rows=int(self.row_group_rows))
         return path
 
     # -- training dispatch ---------------------------------------------------
