@@ -169,6 +169,15 @@ class HorovodEstimator(EstimatorParams):
                 + self._extra_cols())
         fs = getattr(store, "fs", None)
         if _is_spark_df(df):
+            if int(self.row_group_rows) != 4096:
+                # Spark's writer sizes row groups in BYTES
+                # (parquet.block.size), not rows; this knob only shapes
+                # the pandas/dict materialization path
+                import logging
+                logging.getLogger("horovod_tpu").warning(
+                    "row_group_rows is ignored for Spark DataFrames — "
+                    "configure spark.hadoop.parquet.block.size on the "
+                    "session instead")
             df.select(cols).write.mode("overwrite").parquet(path)
         else:
             # pandas or dict-of-arrays
