@@ -52,6 +52,14 @@ from .exceptions import (  # noqa: F401
 
 def __getattr__(name):
     # Lazy surface for heavier subsystems so `import horovod_tpu` stays cheap.
+    if name in ("metrics_snapshot", "metrics_allgather_summary"):
+        from . import metrics
+        return {"metrics_snapshot": metrics.snapshot,
+                "metrics_allgather_summary":
+                    metrics.metrics_allgather_summary}[name]
+    if name == "metrics":
+        import importlib
+        return importlib.import_module(".metrics", __name__)
     if name in ("DistributedOptimizer", "DistributedGradientTransform"):
         from . import optimizer
         return getattr(optimizer, name)

@@ -98,6 +98,23 @@ class _Bindings:
             ctypes.c_int32, ctypes.c_char_p]
         c.hvd_tl_close.argtypes = [ctypes.c_void_p]
 
+        # metrics
+        c.hvd_mtr_create.restype = ctypes.c_void_p
+        c.hvd_mtr_destroy.argtypes = [ctypes.c_void_p]
+        c.hvd_mtr_add.argtypes = [ctypes.c_void_p, ctypes.c_double]
+        c.hvd_mtr_set.argtypes = [ctypes.c_void_p, ctypes.c_double]
+        c.hvd_mtr_get.restype = ctypes.c_double
+        c.hvd_mtr_get.argtypes = [ctypes.c_void_p]
+        c.hvd_hist_create.restype = ctypes.c_void_p
+        c.hvd_hist_create.argtypes = [
+            ctypes.POINTER(ctypes.c_double), ctypes.c_int32]
+        c.hvd_hist_destroy.argtypes = [ctypes.c_void_p]
+        c.hvd_hist_observe.argtypes = [ctypes.c_void_p, ctypes.c_double]
+        c.hvd_hist_read.restype = ctypes.c_int32
+        c.hvd_hist_read.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.c_uint64),
+            ctypes.POINTER(ctypes.c_double), ctypes.POINTER(ctypes.c_uint64)]
+
         # bayesian optimization
         c.hvd_bo_create.restype = ctypes.c_void_p
         c.hvd_bo_create.argtypes = [

@@ -35,10 +35,20 @@ import threading
 from typing import Optional, Sequence
 
 from . import config as _config
+from . import metrics as _metrics
 from .exceptions import NotInitializedError
 
 _lock = threading.Lock()
 _world: Optional["World"] = None
+
+_M_INITS = _metrics.counter(
+    "hvd_tpu_init_total",
+    "hvd.init() completions (elastic resets re-init, so a climbing count "
+    "on a long-lived process is a reset-rate signal).")
+_M_SHUTDOWNS = _metrics.counter(
+    "hvd_tpu_shutdown_total", "hvd.shutdown() completions.")
+_M_WORLD_SIZE = _metrics.gauge(
+    "hvd_tpu_world_size", "Process count of the current world.")
 
 
 class World:
@@ -56,6 +66,7 @@ class World:
         self.timeline = None
         self.stall_inspector = None
         self.parameter_manager = None
+        self.metrics_server = None      # Prometheus endpoint (metrics.py)
         self.process_sets = {}
         self.joined = False
         self.shutdown_requested = False
@@ -219,16 +230,49 @@ def init(process_sets: Optional[Sequence[Sequence[int]]] = None,
             heartbeat = cfg.get(_config.HEARTBEAT_TIMEOUT_SECONDS)
             if heartbeat < 0:
                 heartbeat = 10.0 if cfg.get(_config.ELASTIC) else 100.0
-            jax.distributed.initialize(
-                coordinator_address=addr,
-                num_processes=n,
-                process_id=pid,
-                initialization_timeout=int(
+            # Multi-process eager collectives on the CPU backend need a
+            # cross-process implementation; jax versions that default the
+            # flag to "none" fail at the FIRST collective ("Multiprocess
+            # computations aren't implemented on the CPU backend"), not
+            # at init. Select gloo only when the flag is still at that
+            # default, so an explicit user/env choice always wins.
+            missing = object()
+            try:
+                current = jax.config.read(
+                    "jax_cpu_collectives_implementation")
+            except (AttributeError, KeyError):
+                current = missing  # this jax has no such flag to select
+            if current in (None, "none"):
+                try:
+                    jax.config.update(
+                        "jax_cpu_collectives_implementation", "gloo")
+                except Exception:
+                    import logging
+                    logging.getLogger("horovod_tpu").warning(
+                        "could not select gloo CPU collectives; "
+                        "multi-process CPU collectives may fail",
+                        exc_info=True)
+            kwargs = {
+                "coordinator_address": addr,
+                "num_processes": n,
+                "process_id": pid,
+                "initialization_timeout": int(
                     cfg.get(_config.INIT_TIMEOUT_SECONDS)),
-                heartbeat_timeout_seconds=int(heartbeat),
-                shutdown_timeout_seconds=int(
+                "heartbeat_timeout_seconds": int(heartbeat),
+                "shutdown_timeout_seconds": int(
                     cfg.get(_config.SHUTDOWN_TIMEOUT_SECONDS)),
-            )
+            }
+            # the timeout kwargs arrived across jax releases; passing one
+            # an older runtime doesn't know is a TypeError, so offer only
+            # what this jax accepts (older versions fall back to their
+            # built-in heartbeat/shutdown defaults)
+            import inspect
+            accepted = inspect.signature(
+                jax.distributed.initialize).parameters
+            if not any(p.kind is inspect.Parameter.VAR_KEYWORD
+                       for p in accepted.values()):
+                kwargs = {k: v for k, v in kwargs.items() if k in accepted}
+            jax.distributed.initialize(**kwargs)
             w.coordinator_addr = addr
         w.process_id = jax.process_index()
         w.num_processes = jax.process_count()
@@ -242,6 +286,11 @@ def init(process_sets: Optional[Sequence[Sequence[int]]] = None,
 
         from .logging_setup import configure as _configure_logging
         _configure_logging(cfg)
+        # metrics gate + exposition endpoint come up before the other
+        # host services so their own startup telemetry is captured
+        w.metrics_server = _metrics.configure(w)
+        _M_INITS.inc()
+        _M_WORLD_SIZE.set(w.num_processes)
         from .timeline import maybe_start_timeline
         w.timeline = maybe_start_timeline(w)
         from .stall import StallInspector
@@ -280,6 +329,9 @@ def shutdown() -> None:
             w.timeline.close()
         if w.stall_inspector is not None:
             w.stall_inspector.stop()
+        _metrics.stop_http_server(w.metrics_server)
+        w.metrics_server = None
+        _M_SHUTDOWNS.inc()
         if w.coordinator_addr:
             try:
                 _jax().distributed.shutdown()

@@ -34,15 +34,67 @@ reference's tests use when run without a launcher).
 import enum
 import queue
 import threading
+import time as _time
 from typing import List, Optional, Sequence
 
 import numpy as np
 
 from . import basics as _basics
 from . import config as _config
+from . import metrics as _metrics
 from . import timeline as _tl
 from .exceptions import HorovodInternalError, TensorValidationError
 from .tensor_table import Handle, TensorTable, metadata_fingerprint
+
+# -- telemetry: the always-on counterpart of the timeline (metrics.py).
+# Children are pre-bound per verb at import so the submit/dispatch hot
+# path pays plain increments, no label lookups; eager registration also
+# makes every series visible in scrapes before the first collective.
+_M_OPS = _metrics.counter(
+    "hvd_tpu_collective_ops_total",
+    "Eager collectives submitted, by verb.", labels=("op",))
+_M_BYTES = _metrics.counter(
+    "hvd_tpu_collective_bytes_total",
+    "Payload bytes submitted to eager collectives, by verb.",
+    labels=("op",))
+_M_LATENCY = _metrics.histogram(
+    "hvd_tpu_collective_dispatch_seconds",
+    "Dispatcher-thread stage+dispatch wall time per eager collective, by "
+    "verb (consistency exchange, staging, XLA dispatch; device "
+    "completion is asynchronous).", labels=("op",))
+_OP_METRICS = {
+    kind: (_M_OPS.labels(op=kind), _M_BYTES.labels(op=kind),
+           _M_LATENCY.labels(op=kind))
+    for kind in ("allreduce", "grouped_allreduce", "allgather",
+                 "broadcast", "grouped_broadcast", "alltoall")}
+_M_QUEUE_DEPTH = _metrics.gauge(
+    "hvd_tpu_dispatcher_queue_depth",
+    "Eager collectives currently queued on the dispatcher thread.")
+_M_CONSISTENCY = _metrics.counter(
+    "hvd_tpu_consistency_checks_total",
+    "Cross-process metadata consistency checks, by result "
+    "(cached = ResponseCache fast path skipped the exchange).",
+    labels=("result",))
+_M_CONSISTENCY_CACHED = _M_CONSISTENCY.labels(result="cached")
+_M_CONSISTENCY_EXCHANGED = _M_CONSISTENCY.labels(result="exchanged")
+_M_CONSISTENCY_FAILED = _M_CONSISTENCY.labels(result="failed")
+
+
+def _observed(kind: str, nbytes: int, fn):
+    """Count a submission now (caller thread: submissions are recorded
+    even if the dispatcher never runs them) and wrap ``fn`` so its
+    dispatcher-thread wall time lands in the per-verb latency histogram."""
+    ops_c, bytes_c, lat_h = _OP_METRICS[kind]
+    ops_c.inc()
+    bytes_c.inc(nbytes)
+
+    def wrapped():
+        t0 = _time.perf_counter()
+        try:
+            return fn()
+        finally:
+            lat_h.observe(_time.perf_counter() - t0)
+    return wrapped
 
 
 class ReduceOp(enum.Enum):
@@ -231,6 +283,11 @@ class _Dispatcher:
             finally:
                 h.event.set()
             return
+        # inc/dec (not set(qsize())): two threads racing absolute writes
+        # can strand a stale depth; balanced atomic deltas cannot. Inc
+        # BEFORE put: the dispatcher may pop and dec the instant the item
+        # lands, and inc-after would let a scrape read a negative depth.
+        _M_QUEUE_DEPTH.inc()
         self._q.put((h, fn))
 
     def run_sync(self, fn):
@@ -252,6 +309,7 @@ class _Dispatcher:
         if self._stopped:
             raise HorovodInternalError(
                 "Horovod has been shut down; collective was not dispatched.")
+        _M_QUEUE_DEPTH.inc()  # before put — see submit()
         self._q.put((None, wrapper))
         done.wait()
         if "error" in box:
@@ -262,7 +320,8 @@ class _Dispatcher:
         while True:
             item = self._q.get()
             if item is None:
-                break
+                break  # stop() sentinel: never counted in the depth gauge
+            _M_QUEUE_DEPTH.dec()
             h, fn = item
             if h is None:
                 fn()  # run_sync wrapper handles its own errors
@@ -281,6 +340,7 @@ class _Dispatcher:
                 return
             if item is None:
                 continue
+            _M_QUEUE_DEPTH.dec()
             h, fn = item
             if h is not None:
                 h.error = HorovodInternalError(
@@ -356,6 +416,7 @@ def _check_consistency(w, wm, name, shape, dtype, kind, extra=""):
             w._consistency_seq = 0
     with w._consistency_lock:
         if cache.lookup(cache_key):
+            _M_CONSISTENCY_CACHED.inc()
             return
         w._consistency_seq = (w._consistency_seq + 1) & 0x7FFFFFFF
         # two u32 lanes (not one u64: without jax_enable_x64, uint64 arrays
@@ -386,6 +447,7 @@ def _check_consistency(w, wm, name, shape, dtype, kind, extra=""):
                 "steady per-round sequence — submit the same collectives "
                 "every step and call join_round() once per step.")
         if len(set(seqs)) > 1:
+            _M_CONSISTENCY_FAILED.inc()
             raise TensorValidationError(
                 f"Consistency-exchange sequence mismatch at collective "
                 f"{name!r} ({kind}): per-process exchange counts "
@@ -394,6 +456,7 @@ def _check_consistency(w, wm, name, shape, dtype, kind, extra=""):
                 f"response caches diverged). All processes must submit the "
                 f"same collectives in the same order." + join_hint)
         if len(set(fps)) > 1:
+            _M_CONSISTENCY_FAILED.inc()
             mine = fps[wm.my_index]
             bad = [i for i, x in enumerate(fps) if x != mine]
             raise TensorValidationError(
@@ -401,6 +464,7 @@ def _check_consistency(w, wm, name, shape, dtype, kind, extra=""):
                 f"processes {bad} submitted a different shape/dtype/op than "
                 f"process {wm.my_index}. All processes must submit "
                 f"identical requests for the same tensor name." + join_hint)
+        _M_CONSISTENCY_EXCHANGED.inc()
         cache.put(cache_key)
 
 
@@ -648,7 +712,7 @@ def allreduce_async(tensor, average=None, name: Optional[str] = None,
         tl.activity_end(name)
         return out
 
-    _dispatcher(w).submit(h, dispatch)
+    _dispatcher(w).submit(h, _observed("allreduce", local.nbytes, dispatch))
     return _register_async(w, h)
 
 
@@ -733,7 +797,8 @@ def grouped_allreduce_async(tensors: Sequence, average=None,
         tl.activity_end(base)
         return outs
 
-    _dispatcher(w).submit(h, dispatch)
+    _dispatcher(w).submit(h, _observed(
+        "grouped_allreduce", sum(l.nbytes for l in locals_), dispatch))
     return _register_async(w, h)
 
 
@@ -811,7 +876,7 @@ def allgather_async(tensor, name: Optional[str] = None, process_set=None) -> int
         tl.activity_end(name)
         return result
 
-    _dispatcher(w).submit(h, dispatch)
+    _dispatcher(w).submit(h, _observed("allgather", local.nbytes, dispatch))
     return _register_async(w, h)
 
 
@@ -874,7 +939,7 @@ def broadcast_async(tensor, root_rank: int, name: Optional[str] = None,
         tl.activity_end(name)
         return result
 
-    _dispatcher(w).submit(h, dispatch)
+    _dispatcher(w).submit(h, _observed("broadcast", local.nbytes, dispatch))
     return _register_async(w, h)
 
 
@@ -934,7 +999,8 @@ def grouped_broadcast_async(tensors: Sequence, root_rank: int,
         tl.activity_end(base)
         return results
 
-    _dispatcher(w).submit(h, dispatch)
+    _dispatcher(w).submit(h, _observed(
+        "grouped_broadcast", sum(l.nbytes for l in locals_), dispatch))
     return _register_async(w, h)
 
 
@@ -1066,7 +1132,7 @@ def alltoall_async(tensor, splits=None, name: Optional[str] = None,
         tl.activity_end(name)
         return result
 
-    _dispatcher(w).submit(h, dispatch)
+    _dispatcher(w).submit(h, _observed("alltoall", local.nbytes, dispatch))
     return _register_async(w, h)
 
 

@@ -34,6 +34,15 @@ import numpy as np
 
 from . import basics as _basics
 from . import collectives as _c
+from . import metrics as _metrics
+
+_M_VARIANTS = _metrics.counter(
+    "hvd_tpu_autotune_compiled_variants_total",
+    "Compiled-plane program variants measured by autotune_variants().")
+_M_TUNES = _metrics.counter(
+    "hvd_tpu_autotune_compiled_tunes_total",
+    "Completed compiled-plane tuning rounds (one variant adopted "
+    "world-wide per round).")
 
 
 def autotune_variants(variants: Dict[str, Callable], args: Sequence = (),
@@ -60,6 +69,7 @@ def autotune_variants(variants: Dict[str, Callable], args: Sequence = (),
         for _ in range(max(1, iters)):
             jax.block_until_ready(fn(*args))
         times[n] = (time.perf_counter() - t0) / max(1, iters)
+        _M_VARIANTS.inc()
     best_idx = names.index(min(names, key=lambda n: times[n]))
     w = _basics.world()
     if w.num_processes > 1:
@@ -67,6 +77,7 @@ def autotune_variants(variants: Dict[str, Callable], args: Sequence = (),
                            name=f"hvd_tpu.autotune.compiled.{key}")
         best_idx = int(np.asarray(out)[0])
     chosen = names[best_idx]
+    _M_TUNES.inc()
     _log_choice(w, key, chosen, times)
     return chosen, variants[chosen], times
 

@@ -229,6 +229,34 @@ CHECK_CONSISTENCY = _register(
          "controller.cc:378-611); the ResponseCache makes the steady-state "
          "cost one cached lookup. Set HVD_TPU_CHECK_CONSISTENCY=0 to disable.")
 
+# -- Metrics / telemetry (no direct reference equivalent: the reference
+#    only ships Timeline + StallInspector; these knobs gate the third
+#    observability pillar, metrics.py) ---------------------------------------
+METRICS = _register(
+    "METRICS", True, _parse_bool, alias="HOROVOD_METRICS",
+    help="Enable the metrics registry (counters/gauges/histograms across "
+         "the collective path). Default ON: updates are one atomic add, "
+         "so unscraped metrics cost near nothing. Set HVD_TPU_METRICS=0 "
+         "to make every instrumentation point a no-op.")
+METRICS_PORT = _register(
+    "METRICS_PORT", 0, int, alias="HOROVOD_METRICS_PORT",
+    help="Port for the Prometheus text-format HTTP endpoint (GET "
+         "/metrics). 0 (default) disables the endpoint; snapshots stay "
+         "available via hvd.metrics_snapshot().")
+METRICS_ADDR = _register(
+    "METRICS_ADDR", "0.0.0.0", str, alias="HOROVOD_METRICS_ADDR",
+    help="Bind address for the metrics endpoint. The default 0.0.0.0 "
+         "exposes it on every interface (scraping from off-host is the "
+         "point); set 127.0.0.1 on multi-tenant hosts where telemetry "
+         "should stay local.")
+METRICS_ALL_RANKS = _register(
+    "METRICS_ALL_RANKS", False, _parse_bool,
+    alias="HOROVOD_METRICS_ALL_RANKS",
+    help="Serve the metrics endpoint from every process instead of rank "
+         "0 only. Processes sharing a host need distinct "
+         "HVD_TPU_METRICS_PORT values; a failed bind logs a warning and "
+         "training continues.")
+
 # -- Misc -------------------------------------------------------------------
 NUM_STREAMS = _register(
     "NUM_STREAMS", 1, int, alias="HOROVOD_NUM_NCCL_STREAMS",

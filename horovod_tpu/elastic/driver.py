@@ -17,10 +17,25 @@ import time
 from collections import defaultdict
 from typing import Callable, Dict, List, Optional, Tuple
 
+from .. import metrics as _metrics
 from ..runner.hosts import HostInfo, SlotInfo, get_host_assignments
 from .discovery import DiscoveredHosts, HostManager
 from .registration import WorkerStateRegistry
 from .worker import WorkerNotificationClient
+
+# Elastic membership events as counters: a flapping host shows up as a
+# climbing add/remove rate on the driver's scrape, which no single worker
+# can observe from inside its own generation.
+_M_RESETS = _metrics.counter(
+    "hvd_tpu_elastic_resets_total",
+    "Elastic generation resets (resume() after membership change or "
+    "worker failure).")
+_M_RANK_ADDED = _metrics.counter(
+    "hvd_tpu_elastic_rank_added_total",
+    "Worker slots added relative to the previous elastic generation.")
+_M_RANK_REMOVED = _metrics.counter(
+    "hvd_tpu_elastic_rank_removed_total",
+    "Worker slots removed relative to the previous elastic generation.")
 
 DISCOVER_HOSTS_FREQUENCY_SECS = 1.0
 DEFAULT_ELASTIC_TIMEOUT_SECS = 600
@@ -138,6 +153,7 @@ class ElasticDriver:
         process of the previous generation is known dead (peer-death
         cascade), so every slot of the new generation must be spawned —
         not only slots that were previously unassigned."""
+        _M_RESETS.inc()
         self._activate_workers(self._min_np, respawn_all=respawn_all)
 
     def stop(self, error_message: Optional[str] = None) -> None:
@@ -301,6 +317,17 @@ class ElasticDriver:
                 raise RuntimeError(
                     "no hosts from the previous generation remain; there is "
                     "no surviving rank to broadcast state from")
+            # membership delta vs the previous generation (the initial
+            # start is not a membership "change")
+            prev = {(host, s.local_rank)
+                    for host, slots in self._host_assignments.items()
+                    for s in slots}
+            new = {(host, s.local_rank)
+                   for host, slots in by_host.items() for s in slots}
+            if new - prev:
+                _M_RANK_ADDED.inc(len(new - prev))
+            if prev - new:
+                _M_RANK_REMOVED.inc(len(prev - new))
         self._host_assignments = by_host
         self._world_size = len(assignment_list)
         # The generation being formed already reflects current membership;

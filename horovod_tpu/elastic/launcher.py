@@ -110,6 +110,27 @@ def launch_elastic(args) -> int:
         timeout=args.elastic_timeout, reset_limit=args.reset_limit)
     attach_elastic_handlers(rendezvous, driver)
 
+    # The elastic membership counters (driver.py) live in THIS process,
+    # not in any worker, so the launcher serves its own scrape endpoint
+    # when the metrics port is configured. Workers bind the same port on
+    # their own hosts; a same-host collision just logs and continues.
+    metrics_server = None
+    try:
+        metrics_port = int(os.environ.get(
+            "HVD_TPU_METRICS_PORT",
+            os.environ.get("HOROVOD_METRICS_PORT", "0")) or 0)
+    except ValueError:
+        metrics_port = 0
+    if metrics_port > 0:
+        from .. import metrics as _metrics
+        try:
+            metrics_server = _metrics.start_http_server(metrics_port)
+        except (OSError, OverflowError, ValueError) as e:
+            import logging
+            logging.getLogger("horovod_tpu.elastic").warning(
+                "elastic launcher: could not bind metrics endpoint on "
+                "port %d: %s", metrics_port, e)
+
     def publish_coordinator(assignment_list):
         # New generation -> new JAX coordinator on the new rank-0 host.
         head = assignment_list[0]
@@ -166,6 +187,9 @@ def launch_elastic(args) -> int:
         sys.stderr.write(f"horovodrun-tpu: {e}\n")
         return 1
     finally:
+        if metrics_server is not None:
+            from .. import metrics as _metrics
+            _metrics.stop_http_server(metrics_server)
         if own_state_dir:
             shutil.rmtree(own_state_dir, ignore_errors=True)
 

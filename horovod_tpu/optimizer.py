@@ -35,7 +35,13 @@ import numpy as np
 from . import basics as _basics
 from . import collectives as _c
 from . import config as _config
+from . import metrics as _metrics
 from .compression import Compression
+
+_M_STEPS = _metrics.counter(
+    "hvd_tpu_optimizer_steps_total",
+    "Eager DistributedOptimizer reduction steps (compiled-plane steps "
+    "run inside jit and are counted by the training loop instead).")
 
 
 class DistributedGradientTransform:
@@ -173,6 +179,7 @@ class DistributedGradientTransform:
             else w.config.get(_config.FUSION_THRESHOLD)
         leaves, treedef = jax.tree_util.tree_flatten(grads)
         self._step += 1
+        _M_STEPS.inc()
         # stable names across steps: the ResponseCache fast path and the
         # reference's per-parameter naming (torch/optimizer.py:111-117) both
         # key on them; duplicate in-flight protection comes from the

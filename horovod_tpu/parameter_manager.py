@@ -43,7 +43,21 @@ import time
 from typing import Optional
 
 from . import config as _config
+from . import metrics as _metrics
 from ._native import get as _native_get
+
+# The live tuned values as gauges: when throughput shifts after a knob
+# lock, the operator sees WHICH threshold the tuner settled on without
+# grepping the autotune log.
+_M_FUSION_GAUGE = _metrics.gauge(
+    "hvd_tpu_autotune_fusion_threshold_bytes",
+    "Current gradient-bucket fusion threshold (autotuned or configured).")
+_M_CUTOFF_GAUGE = _metrics.gauge(
+    "hvd_tpu_autotune_pack_cutoff_bytes",
+    "Current host-packing cutoff (autotuned or configured).")
+_M_SAMPLES = _metrics.counter(
+    "hvd_tpu_autotune_samples_total",
+    "Autotune throughput samples scored (warmup samples excluded).")
 
 # Tuned knobs in phase order: (config name, log2 lo, log2 hi).
 # Fusion threshold searches [1 MB, 256 MB]; pack cutoff [4 KB, 4 MB].
@@ -130,7 +144,12 @@ class ParameterManager:
         self._bytes_acc = 0
         self._time_acc = 0.0
         self._finished = False
+        self._publish_gauges()
         self._enter_phase(0)
+
+    def _publish_gauges(self) -> None:
+        _M_FUSION_GAUGE.set(self._values["FUSION_THRESHOLD"])
+        _M_CUTOFF_GAUGE.set(self._values["PACK_CUTOFF"])
 
     def _enter_phase(self, phase: int) -> None:
         self._phase = phase
@@ -184,6 +203,7 @@ class ParameterManager:
         if score > self._best[1]:
             self._best = (value, score)
         self._samples_done += 1
+        _M_SAMPLES.inc()
         self._log(f"sample {self._samples_done} {name}={value} "
                   f"score={score:.3e} bytes/sec")
         if self._samples_done >= self._max_samples:
@@ -191,6 +211,7 @@ class ParameterManager:
             # everywhere, like every other proposal
             self._values[name] = int(self._sync(float(self._best[0])))
             self._world.config.set(name, self._values[name])
+            self._publish_gauges()
             if self._phase + 1 < len(_KNOBS):
                 self._log(f"knob locked: {name}={self._values[name]} "
                           f"score={self._best[1]:.3e}; tuning "
@@ -207,6 +228,7 @@ class ParameterManager:
         proposal = 1 << int(round(self._sync(self._opt.suggest())))
         self._values[name] = proposal
         self._world.config.set(name, self._values[name])
+        self._publish_gauges()
 
     def _sync(self, proposal: float) -> float:
         """Adopt rank 0's proposal in a multi-process world (reference:

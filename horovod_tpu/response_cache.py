@@ -28,7 +28,23 @@ import collections
 import threading
 from typing import Optional
 
+from . import metrics as _metrics
 from ._native import get as _native_get
+
+# Cache efficiency is the steady-state health signal of the collective
+# path: a hit means the consistency exchange (a device round-trip) was
+# skipped; a miss storm on one rank shows up in metrics_allgather_summary
+# long before it shows up as throughput loss.
+_M_HITS = _metrics.counter(
+    "hvd_tpu_response_cache_hits_total",
+    "Response-cache hits (consistency exchange skipped).")
+_M_MISSES = _metrics.counter(
+    "hvd_tpu_response_cache_misses_total",
+    "Response-cache misses (full cross-process exchange performed).")
+_M_EVICTIONS = _metrics.counter(
+    "hvd_tpu_response_cache_evictions_total",
+    "Response-cache LRU evictions (capacity pressure; evicted "
+    "fingerprints re-validate on next submission).")
 
 
 class ResponseCache:
@@ -52,14 +68,20 @@ class ResponseCache:
     def lookup(self, key: int) -> bool:
         """True when `key` was previously validated (refreshes LRU order)."""
         if self.capacity <= 0:
+            _M_MISSES.inc()  # disabled cache: every check re-exchanges
             return False
         if self._h is not None:
-            return bool(self._nat.cdll.hvd_cache_lookup(self._h, key))
+            hit = bool(self._nat.cdll.hvd_cache_lookup(self._h, key))
+            (_M_HITS if hit else _M_MISSES).inc()
+            return hit
         with self._lock:
             if key in self._lru:
                 self._lru.move_to_end(key)
-                return True
-            return False
+                hit = True
+            else:
+                hit = False
+        (_M_HITS if hit else _M_MISSES).inc()
+        return hit
 
     def put(self, key: int) -> Optional[int]:
         """Insert a validated key; returns the evicted key, if any."""
@@ -70,6 +92,7 @@ class ResponseCache:
             evicted = ctypes.c_uint64(0)
             if self._nat.cdll.hvd_cache_put(self._h, key,
                                             ctypes.byref(evicted)):
+                _M_EVICTIONS.inc()
                 return int(evicted.value)
             return None
         with self._lock:
@@ -80,7 +103,9 @@ class ResponseCache:
             if len(self._lru) >= self.capacity:
                 victim, _ = self._lru.popitem(last=False)
             self._lru[key] = None
-            return victim
+        if victim is not None:
+            _M_EVICTIONS.inc()
+        return victim
 
     def erase(self, key: int) -> None:
         """Invalidate one entry (reference: stalled tensors are invalidated,

@@ -76,32 +76,42 @@ def run(fn, args=(), kwargs=None, np: int = 1,
                 # coordinator_addr_for — a local probe would test the
                 # wrong machine)
                 worker_env["HVD_TPU_COORDINATOR_ADDR"] = coordinator_addr
-            rc = mpi_run(
+            mpi_rc = mpi_run(
                 MPISettings(num_proc=size, hosts=hosts_str,
                             verbose=verbose),
                 worker_env, command)
-            # mpirun yields one aggregate exit code for the whole gang
-            codes = [rc] * size
+            # mpirun yields ONE aggregate exit code for the whole gang;
+            # synthesizing per-rank codes from it would blame every rank
+            # for a one-rank failure (ADVICE r5 #4). The failing rank, if
+            # identifiable, surfaces from its KV error payload below.
+            codes = []
         else:
+            mpi_rc = None
             codes = launch_workers(
                 command, slots, coordinator_addr,
                 rendezvous_addr=rdv_host,
                 rendezvous_port=server.port,
                 prefix_output=verbose, base_env=env)
         failed = [(r, c) for r, c in enumerate(codes) if c != 0]
+        any_failed = bool(failed) or (mpi_rc not in (None, 0))
         results = []
         for r in range(size):
             blob = server.get(run_func_result_scope, str(r))
             payload = pickle.loads(blob) if blob is not None else None
             if payload and payload.get("error"):
                 raise RuntimeError(f"rank {r} raised: {payload['error']}")
-            if failed:
+            if any_failed:
                 continue
             if payload is None:
                 raise RuntimeError(f"rank {r} produced no result")
             results.append(payload["value"])
         if failed:
             raise RuntimeError(f"run() workers failed: {failed}")
+        if mpi_rc not in (None, 0):
+            raise RuntimeError(
+                f"run() failed: mpirun exited with code {mpi_rc} (one "
+                f"aggregate code for all {size} ranks; no per-rank error "
+                f"was reported through the rendezvous)")
         return results
     finally:
         server.stop()

@@ -69,8 +69,10 @@ class EstimatorParams:
         self.output_cols: Optional[List[str]] = None
         self.batch_size = 32
         self.epochs = 1
-        #: float fraction in [0, 1) OR a column name whose rows with
-        #: value > 0 form the validation set (both reference forms,
+        #: float fraction in [0, 1) OR a column name (any str, even a
+        #: numeric-looking one) whose rows with value > 0 form the
+        #: validation set; rows with value 0 train, negative rows drop
+        #: out of both sets (both reference forms,
         #: spark/common/params.py `validation`)
         self.validation = None
         #: per-row training weight column (reference `sample_weight_col`)
@@ -138,10 +140,10 @@ class HorovodEstimator(EstimatorParams):
             return None
         v = self.validation
         if isinstance(v, str):
-            try:
-                v = float(v)   # numeric strings keep working as fractions
-            except ValueError:
-                return ("column", self.validation)
+            # ANY string is a column name (reference spark/common/util.py
+            # check_validation) — a column literally named '0.2' must not
+            # be coerced into a fraction (ADVICE r5 #1)
+            return ("column", v)
         frac = float(v)
         if not 0.0 <= frac < 1.0:
             raise ValueError(
@@ -259,11 +261,17 @@ def load_split_shard(train_path: str, feature_cols: List[str],
     w = np.asarray(arrays[k], dtype=np.float32) if sample_weight_col \
         else None
     if val_col:
-        vmask = np.asarray(arrays[-1]) > 0
-        train = [a[~vmask] for a in data]
+        col = np.asarray(arrays[-1])
+        # reference semantics (spark/common/util.py _train_val_split):
+        # train is col == 0 and val is col > 0, so NEGATIVE values drop
+        # out of both sets — not ~(col > 0), which swept them into train
+        # (ADVICE r5 #2)
+        tmask = col == 0
+        vmask = col > 0
+        train = [a[tmask] for a in data]
         val = [a[vmask] for a in data]
         return (train, val,
-                w[~vmask] if w is not None else None,
+                w[tmask] if w is not None else None,
                 w[vmask] if w is not None else None)
     if validation_spec and validation_spec[0] == "fraction" \
             and validation_spec[1] > 0:

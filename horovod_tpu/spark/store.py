@@ -134,8 +134,12 @@ class FsspecStore(FilesystemStore):
 
     def __init__(self, prefix_path: str, *args, **kwargs):
         import fsspec
-        scheme = prefix_path.split("://", 1)[0]
-        self.fs = fsspec.filesystem(scheme)
+        # url_to_fs, not fsspec.filesystem(scheme): the URL may carry
+        # host/port/credentials (hdfs://namenode:8020/..., s3://key:secret@
+        # bucket/...) that scheme-only construction silently discards,
+        # connecting to the default-configured endpoint instead
+        # (ADVICE r5 #5)
+        self.fs, _ = fsspec.core.url_to_fs(prefix_path)
         super().__init__(prefix_path, *args, **kwargs)
 
     def _run_path(self, base: Optional[str], run_id: str, leaf: str) -> str:

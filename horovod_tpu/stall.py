@@ -25,8 +25,19 @@ import time
 from typing import Dict
 
 from . import config as _config
+from . import metrics as _metrics
 from ._native import get as _native_get
 from .exceptions import StallError
+
+# The stall subsystem reports its own events into the metrics pillar, so
+# an alert can fire on a stall long before anyone reads the rank logs.
+_M_STALL_WARNINGS = _metrics.counter(
+    "hvd_tpu_stall_warnings_total",
+    "Collectives that exceeded the stall warning deadline "
+    "(HVD_TPU_STALL_CHECK_TIME_SECONDS).")
+_M_STALL_SHUTDOWNS = _metrics.counter(
+    "hvd_tpu_stall_shutdowns_total",
+    "Stall shutdown deadlines hit (StallError raised to waiters).")
 
 
 class StallInspector:
@@ -85,6 +96,7 @@ class StallInspector:
         poll = min(max(warn_after / 4.0, 0.25), 10.0)
         while not self._stop_evt.wait(poll):
             for name in self._scan(warn_after, shutdown_after):
+                _M_STALL_WARNINGS.inc()
                 log.warning(
                     "One or more collectives stalled for over %.0fs: %s. "
                     "This may indicate that a peer process is down or a "
@@ -94,6 +106,7 @@ class StallInspector:
     def _scan(self, warn_after, shutdown_after):
         """One inspection pass; returns newly-stalled names and updates the
         shutdown flag. Native fast path when built."""
+        prior_hit = self._shutdown_deadline_hit
         if self._h is not None:
             hit = ctypes.c_int32(0)
             buf = ctypes.create_string_buffer(1 << 16)
@@ -102,6 +115,8 @@ class StallInspector:
                 ctypes.byref(hit), buf, len(buf))
             if hit.value:
                 self._shutdown_deadline_hit = True
+            if self._shutdown_deadline_hit and not prior_hit:
+                _M_STALL_SHUTDOWNS.inc()
             return buf.value.decode().split("\n") if n > 0 and buf.value \
                 else []
         now = time.monotonic()
@@ -115,6 +130,8 @@ class StallInspector:
                 newly.append(name)
             if shutdown_after > 0 and waited > shutdown_after:
                 self._shutdown_deadline_hit = True
+        if self._shutdown_deadline_hit and not prior_hit:
+            _M_STALL_SHUTDOWNS.inc()
         return newly
 
     def stop(self):

@@ -20,7 +20,14 @@ import time
 from typing import Optional
 
 from . import config as _config
+from . import metrics as _metrics
 from ._native import get as _native_get
+
+# The observability layer observes itself: emission volume is how an
+# operator notices a timeline silently eating disk (or silently dead).
+_M_TL_EVENTS = _metrics.counter(
+    "hvd_tpu_timeline_events_total",
+    "Chrome-tracing events emitted by the timeline writer.")
 
 # Host-side activity names, mirroring the reference's
 # (/root/reference/horovod/common/common.h:31-59).
@@ -98,6 +105,7 @@ class Timeline:
     def _emit(self, name, ph, tensor_name, args=None):
         if self._closed:
             return
+        _M_TL_EVENTS.inc()
         if self._h is not None:
             tid = self._tid(tensor_name)
             with self._native_lock:
@@ -134,6 +142,7 @@ class Timeline:
         # chrome tracing closes the innermost open B for this tid
         if self._closed:
             return
+        _M_TL_EVENTS.inc()
         if self._h is not None:
             tid = self._tid(tensor_name)
             with self._native_lock:
@@ -149,6 +158,7 @@ class Timeline:
 
     def mark_cycle(self):
         if self._mark_cycles and not self._closed:
+            _M_TL_EVENTS.inc()
             if self._h is not None:
                 with self._native_lock:
                     if self._h is None:

@@ -1,0 +1,88 @@
+"""Worker for the multi-process metrics tests.
+
+Each rank runs a short eager collective mix, plus a deliberately skewed
+LOCAL metric (rank r bumps a custom counter r times — collectives
+themselves must stay in lockstep across ranks, so skew can only come
+from rank-local instrumentation). Then every rank calls
+``metrics_allgather_summary()`` — a collective — and asserts the
+cross-rank view: per_rank has one snapshot per rank, the shared
+allreduce series agree everywhere, and the skewed local series shows up
+as a max-min spread in the aggregate. Rank 0 additionally scrapes its
+own Prometheus endpoint when HVD_TPU_METRICS_PORT is set.
+"""
+
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+import horovod_tpu as hvd  # noqa: E402
+
+
+def main() -> None:
+    hvd.init()
+    rank, size = hvd.rank(), hvd.size()
+
+    for _step in range(3):
+        # one stable name across steps: steps 2-3 take the ResponseCache
+        # fast path, which the cache hit/miss assertions below rely on
+        out = hvd.allreduce(np.ones((4,), np.float32), op=hvd.Sum,
+                            name="m.loop")
+        np.testing.assert_allclose(np.asarray(out), size * np.ones(4))
+    hvd.allgather(np.ones((2,), np.float32), name="m.gather")
+    # skew: a rank-LOCAL counter rank r bumps r times (a collective
+    # submitted unevenly would violate the SPMD lockstep instead)
+    skew_counter = hvd.metrics.counter(
+        "test_rank_skew_total", "per-rank skew for the summary test")
+    for _ in range(rank):
+        skew_counter.inc()
+
+    snap = hvd.metrics_snapshot()
+    ops = snap['hvd_tpu_collective_ops_total{op="allreduce"}']
+    assert ops >= 3, f"rank {rank}: allreduce ops {ops}"
+    assert snap['hvd_tpu_collective_bytes_total{op="allreduce"}'] >= 3 * 16
+    lat = snap['hvd_tpu_collective_dispatch_seconds{op="allreduce"}']
+    assert lat["count"] >= 3 and lat["sum"] > 0
+
+    # consistency checks ran (multi-process world, default-on): steady
+    # state means the first exchange validated and the rest were cached
+    checks = (snap['hvd_tpu_consistency_checks_total{result="cached"}']
+              + snap['hvd_tpu_consistency_checks_total{result="exchanged"}'])
+    assert checks >= 3, f"rank {rank}: consistency checks {checks}"
+    assert snap["hvd_tpu_response_cache_hits_total"] >= 1
+    assert snap["hvd_tpu_response_cache_misses_total"] >= 1
+
+    summary = hvd.metrics_allgather_summary()
+    assert len(summary["per_rank"]) == size
+    for r, s in enumerate(summary["per_rank"]):
+        assert s['hvd_tpu_collective_ops_total{op="allreduce"}'] >= 3, \
+            f"rank {r} snapshot missing allreduce ops"
+        assert s["test_rank_skew_total"] == r, \
+            f"rank {r} skew counter {s['test_rank_skew_total']}"
+    agg = summary["aggregate"]['hvd_tpu_collective_ops_total{op="allreduce"}']
+    assert agg["sum"] >= 3 * size
+    # the deliberate per-rank skew is visible from every process
+    skew = summary["aggregate"]["test_rank_skew_total"]
+    assert skew["min"] == 0 and skew["max"] == size - 1, \
+        f"skew not visible: {skew}"
+    assert skew["sum"] == size * (size - 1) / 2
+
+    port = int(os.environ.get("HVD_TPU_METRICS_PORT", "0"))
+    if port and rank == 0:
+        import urllib.request
+        text = urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/metrics", timeout=10).read().decode()
+        assert 'hvd_tpu_collective_ops_total{op="allreduce"}' in text
+        assert "hvd_tpu_collective_dispatch_seconds_bucket" in text
+        assert "# TYPE hvd_tpu_collective_dispatch_seconds histogram" in text
+
+    hvd.barrier()
+    hvd.shutdown()
+    print(f"worker {rank} OK", flush=True)
+
+
+if __name__ == "__main__":
+    main()

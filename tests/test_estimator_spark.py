@@ -777,3 +777,76 @@ def test_streaming_vector_feature_column(hvd_world, tmp_path):
             np.testing.assert_allclose(r, vec[i])
             rows.append(int(i))
     assert sorted(rows) == list(range(100))
+
+
+# ---------------------------------------------------------------------------
+# round 6 (ADVICE r5): validation-spec typing, split semantics, store URL
+# ---------------------------------------------------------------------------
+
+def test_validation_spec_numeric_string_is_column_name():
+    """ADVICE r5 #1: the reference (spark/common/util.py check_validation)
+    treats ANY string as a column name — a column literally named '0.2'
+    (or '2') must not be coerced into a fraction."""
+    from horovod_tpu.spark.estimator import HorovodEstimator
+
+    assert HorovodEstimator(validation="0.2")._validation_spec() == \
+        ("column", "0.2")
+    assert HorovodEstimator(validation="2")._validation_spec() == \
+        ("column", "2")   # previously raised: float('2') out of range
+    assert HorovodEstimator(validation="is_val")._validation_spec() == \
+        ("column", "is_val")
+    # float instances stay fractions, with the range check intact
+    assert HorovodEstimator(validation=0.25)._validation_spec() == \
+        ("fraction", 0.25)
+    with pytest.raises(ValueError, match="validation"):
+        HorovodEstimator(validation=1.5)._validation_spec()
+    assert HorovodEstimator()._validation_spec() is None
+
+
+def test_load_split_shard_drops_negative_validation_rows(tmp_path):
+    """ADVICE r5 #2: reference split semantics are train = (col == 0),
+    val = (col > 0) — NEGATIVE column values fall out of both sets
+    instead of being swept into train by ~(col > 0)."""
+    from horovod_tpu.spark.estimator import load_split_shard
+    from horovod_tpu.spark.store import write_parquet
+
+    path = str(tmp_path / "ds")
+    n = 12
+    # rows 0-3 train (0), 4-7 validation (+1), 8-11 excluded (-1)
+    val_col = np.array([0] * 4 + [1] * 4 + [-1] * 4, np.int64)
+    write_parquet(path, {
+        "x": np.arange(n, dtype=np.float32),
+        "label": np.arange(n, dtype=np.float32),
+        "is_val": val_col,
+        "wgt": np.ones(n, np.float32) * 2,
+    })
+    train, val, w_train, w_val = load_split_shard(
+        path, ["x"], ["label"], rank=0, size=1,
+        sample_weight_col="wgt", validation_spec=("column", "is_val"))
+    np.testing.assert_array_equal(train[0], [0, 1, 2, 3])
+    np.testing.assert_array_equal(val[0], [4, 5, 6, 7])
+    assert len(w_train) == 4 and len(w_val) == 4
+
+
+def test_fsspec_store_builds_filesystem_from_full_url(monkeypatch):
+    """ADVICE r5 #5: the filesystem must come from url_to_fs(prefix) so
+    host/port/credentials embedded in the store URL are honored, not
+    from the bare scheme (which silently connects to the
+    default-configured endpoint)."""
+    fsspec = pytest.importorskip("fsspec")
+    from horovod_tpu.spark import store as store_mod
+
+    seen = {}
+    real = fsspec.core.url_to_fs
+
+    def spy(url, **kw):
+        seen["url"] = url
+        return real(url, **kw)
+
+    monkeypatch.setattr(fsspec.core, "url_to_fs", spy)
+    s = store_mod.FsspecStore("memory://namenode:8020/prefix")
+    assert seen["url"] == "memory://namenode:8020/prefix"
+    assert s.fs is not None
+    # path building still keeps the scheme-full prefix
+    assert s.get_train_data_path("r1").startswith(
+        "memory://namenode:8020/prefix/runs/r1")

@@ -569,3 +569,48 @@ def test_mpi_run_strips_driver_scheduler_identity():
                 "PMI_SIZE", "OMPI_COMM_WORLD_RANK"):
         assert var not in env, var
     assert env["KEEPME"] == "1"
+
+
+def test_programmatic_run_use_mpi_reports_aggregate_rc(monkeypatch):
+    """ADVICE r5 #4: mpirun yields ONE exit code for the whole gang; a
+    failure must be reported as that aggregate code, not synthesized
+    into per-rank codes that blame every rank."""
+    import horovod_tpu.runner.api as api_mod
+    import horovod_tpu.runner.mpi_run as mpi_mod
+
+    monkeypatch.setattr(mpi_mod, "mpi_run",
+                        lambda settings, env, command: 137)
+    with pytest.raises(RuntimeError) as ei:
+        api_mod.run(lambda: None, np=2, use_mpi=True,
+                    disable_ssh_check=True)
+    msg = str(ei.value)
+    assert "mpirun exited with code 137" in msg
+    # no fabricated per-rank blame of the whole gang
+    assert "workers failed" not in msg
+    assert "[(0, 137), (1, 137)]" not in msg
+
+
+def test_programmatic_run_use_mpi_prefers_per_rank_error(monkeypatch):
+    """When a rank DID report an error through the KV rendezvous, that
+    specific rank's failure is raised instead of the opaque aggregate
+    mpirun code."""
+    import pickle
+
+    import horovod_tpu.runner.api as api_mod
+    import horovod_tpu.runner.mpi_run as mpi_mod
+
+    def fake_mpi_run(settings, env, command):
+        # simulate rank 1 dying after publishing its error payload
+        import urllib.request
+        port = env["HVD_TPU_RENDEZVOUS_PORT"]
+        blob = pickle.dumps({"error": "boom on rank 1"})
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/{api_mod.run_func_result_scope}/1",
+            data=blob, method="PUT")
+        urllib.request.urlopen(req)
+        return 1
+
+    monkeypatch.setattr(mpi_mod, "mpi_run", fake_mpi_run)
+    with pytest.raises(RuntimeError, match="rank 1 raised: boom on rank 1"):
+        api_mod.run(lambda: None, np=2, use_mpi=True,
+                    disable_ssh_check=True)
