@@ -22,7 +22,13 @@ COMPOSE_PATH = os.path.join(HERE, "docker-compose.test.yml")
 
 #: suites every service runs (path, parallelism-safe, timeout minutes)
 COMMON_SUITES = [
-    ("unit", "python -m pytest tests/ -q -m 'not integration'", 30),
+    ("lint-knobs", "python tools/check_knobs.py", 5),
+    # chaos tests are excluded here because the chaos suite below is
+    # their single owner — without the filter every fast chaos test
+    # would run twice per service
+    ("unit",
+     "python -m pytest tests/ -q -m 'not integration and not chaos'", 30),
+    ("chaos", "python -m pytest tests/ -q -m chaos", 20),
     ("multiproc",
      "python -m pytest tests/test_multiprocess_integration.py -q", 30),
     ("elastic", "python -m pytest tests/test_elastic_e2e.py -q", 40),

@@ -57,9 +57,9 @@ def __getattr__(name):
         return {"metrics_snapshot": metrics.snapshot,
                 "metrics_allgather_summary":
                     metrics.metrics_allgather_summary}[name]
-    if name == "metrics":
+    if name in ("metrics", "faults", "retry"):
         import importlib
-        return importlib.import_module(".metrics", __name__)
+        return importlib.import_module("." + name, __name__)
     if name in ("DistributedOptimizer", "DistributedGradientTransform"):
         from . import optimizer
         return getattr(optimizer, name)

@@ -192,6 +192,12 @@ def init(process_sets: Optional[Sequence[Sequence[int]]] = None,
         cfg = _config.Config(config_overrides)
         w = World(cfg)
 
+        # Fail fast on a malformed HVD_TPU_FAULT_SPEC: parsed here (once
+        # per process) so a typo is a startup FaultSpecError, not a
+        # mid-training surprise the elastic loop would retry forever.
+        from . import faults as _faults
+        _faults.ensure_configured()
+
         if comm is not None and isinstance(comm, (list, tuple)):
             try:
                 from mpi4py import MPI

@@ -41,7 +41,9 @@ import numpy as np
 
 from . import basics as _basics
 from . import config as _config
+from . import faults as _faults
 from . import metrics as _metrics
+from . import retry as _retry
 from . import timeline as _tl
 from .exceptions import HorovodInternalError, TensorValidationError
 from .tensor_table import Handle, TensorTable, metadata_fingerprint
@@ -80,6 +82,17 @@ _M_CONSISTENCY_EXCHANGED = _M_CONSISTENCY.labels(result="exchanged")
 _M_CONSISTENCY_FAILED = _M_CONSISTENCY.labels(result="failed")
 
 
+# Chaos sites on the dispatch path (faults.py): one point per verb, fired
+# at the TOP of the dispatched closure — before the consistency exchange
+# or any SPMD dispatch, so an injected fault (or its retry) can never
+# leave this rank's exchange sequence mispaired with its peers'. With no
+# HVD_TPU_FAULT_SPEC these are single-branch no-ops.
+_FAULT_POINTS = {
+    kind: _faults.FaultPoint(f"collective.{kind}")
+    for kind in ("allreduce", "grouped_allreduce", "allgather",
+                 "broadcast", "grouped_broadcast", "alltoall")}
+
+
 def _observed(kind: str, nbytes: int, fn):
     """Count a submission now (caller thread: submissions are recorded
     even if the dispatcher never runs them) and wrap ``fn`` so its
@@ -87,10 +100,12 @@ def _observed(kind: str, nbytes: int, fn):
     ops_c, bytes_c, lat_h = _OP_METRICS[kind]
     ops_c.inc()
     bytes_c.inc(nbytes)
+    fp = _FAULT_POINTS[kind]
 
     def wrapped():
         t0 = _time.perf_counter()
         try:
+            fp.fire()
             return fn()
         finally:
             lat_h.observe(_time.perf_counter() - t0)
@@ -258,9 +273,21 @@ class _Dispatcher:
     def __init__(self):
         self._q: "queue.Queue" = queue.Queue()
         self._stopped = False
+        # Transient-vs-fatal classification for dispatched closures:
+        # connection-shaped errors (retry.is_transient) can only come from
+        # the host-plane stage of a dispatch — fault injection, rendezvous
+        # side channels — never from inside the SPMD program (XLA raises
+        # runtime errors, which are fatal here), so retrying them locally
+        # cannot desynchronize ranks. Fatal errors are NOT retried; they
+        # surface via _wrap_error as HorovodInternalError so the elastic
+        # loop can restore + reset instead of the handle wedging.
+        self._retry = _retry.RetryPolicy.from_config()
         self._thread = threading.Thread(
             target=self._run, daemon=True, name="hvd-tpu-dispatcher")
         self._thread.start()
+
+    def _execute(self, fn):
+        return self._retry.call(fn, site="collective.dispatch")
 
     def submit(self, h: Handle, fn) -> None:
         h.event = threading.Event()
@@ -277,7 +304,7 @@ class _Dispatcher:
             # autotuner broadcast inside a hook): run inline — we are already
             # inside the serialized total order.
             try:
-                h.result = fn()
+                h.result = self._execute(fn)
             except BaseException as e:  # noqa: BLE001 — surfaced at sync
                 h.error = _wrap_error(e)
             finally:
@@ -298,7 +325,7 @@ class _Dispatcher:
 
         def wrapper():
             try:
-                box["result"] = fn()
+                box["result"] = self._execute(fn)
             except BaseException as e:  # noqa: BLE001 — re-raised in caller
                 box["error"] = e
             finally:
@@ -327,7 +354,7 @@ class _Dispatcher:
                 fn()  # run_sync wrapper handles its own errors
                 continue
             try:
-                h.result = fn()
+                h.result = self._execute(fn)
             except BaseException as e:  # noqa: BLE001 — surfaced at sync
                 h.error = _wrap_error(e)
             finally:

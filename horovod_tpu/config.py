@@ -203,6 +203,16 @@ RENDEZVOUS_ADDR = _register(
     "RENDEZVOUS_ADDR", "", str,
     help="Host of the launcher's HTTP KV rendezvous server.")
 ELASTIC = _register("ELASTIC", False, _parse_bool, alias="HOROVOD_ELASTIC")
+ELASTIC_TIMEOUT = _register(
+    "ELASTIC_TIMEOUT", 600.0, float, alias="HOROVOD_ELASTIC_TIMEOUT",
+    help="Seconds the elastic driver waits for the minimum slot count "
+         "before giving up (reference HOROVOD_ELASTIC_TIMEOUT).")
+ELASTIC_DURABLE_COMMITS = _register(
+    "ELASTIC_DURABLE_COMMITS", True, _parse_bool,
+    help="Persist every elastic State.commit() to the job state dir so a "
+         "hard-killed worker's respawn restores its last commit. Set 0 to "
+         "skip the synchronous pickle+write for huge per-batch states "
+         "(recovery then degrades to the rank-0 broadcast).")
 INIT_TIMEOUT_SECONDS = _register(
     "INIT_TIMEOUT_SECONDS", 300.0, float,
     alias="HOROVOD_GLOO_TIMEOUT_SECONDS",
@@ -256,6 +266,40 @@ METRICS_ALL_RANKS = _register(
          "0 only. Processes sharing a host need distinct "
          "HVD_TPU_METRICS_PORT values; a failed bind logs a warning and "
          "training continues.")
+
+# -- Robustness: fault injection + transient-fault retry (no reference
+#    equivalent — the reference can only exercise its recovery machinery
+#    by actually killing processes; faults.py/retry.py make the failure
+#    paths testable and survivable) -------------------------------------------
+FAULT_SPEC = _register(
+    "FAULT_SPEC", "", str,
+    help="Deterministic fault-injection spec, ';'-separated "
+         "site:kind[:param=value...] entries (e.g. "
+         "'rendezvous.get:error:rate=0.3;worker.step:crash:step=12'). "
+         "Empty (default) disables injection entirely; see "
+         "docs/robustness.md for the grammar.")
+FAULT_SEED = _register(
+    "FAULT_SEED", 0, int,
+    help="Seed for every probabilistic fault-injection decision. The same "
+         "seed + spec + call sequence reproduces the same faults on every "
+         "run and every process.")
+RETRY_MAX_ATTEMPTS = _register(
+    "RETRY_MAX_ATTEMPTS", 5, int,
+    help="Total attempts (first call + retries) for transient host-plane "
+         "failures (rendezvous KV ops, worker registration, dispatcher "
+         "host-plane staging).")
+RETRY_INITIAL_BACKOFF = _register(
+    "RETRY_INITIAL_BACKOFF", 0.05, float,
+    help="Base backoff in seconds; retry k sleeps uniform(0, "
+         "min(RETRY_MAX_BACKOFF, RETRY_INITIAL_BACKOFF * 2**(k-1))) "
+         "(capped exponential backoff with full jitter).")
+RETRY_MAX_BACKOFF = _register(
+    "RETRY_MAX_BACKOFF", 2.0, float,
+    help="Upper bound in seconds on any single retry backoff.")
+RETRY_DEADLINE = _register(
+    "RETRY_DEADLINE", 60.0, float,
+    help="Overall per-call retry budget in seconds; a retry that would "
+         "overrun it surfaces the last error instead of sleeping.")
 
 # -- Misc -------------------------------------------------------------------
 NUM_STREAMS = _register(

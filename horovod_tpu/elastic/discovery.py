@@ -11,6 +11,13 @@ import subprocess
 import threading
 from typing import Dict, List, Optional
 
+from .. import faults as _faults
+
+# Chaos site for host discovery: an injected ``error`` behaves exactly
+# like a failing discovery script (RuntimeError) — fatal on the first
+# poll, logged-and-retried on later ones (driver._discover_hosts).
+_FP_DISCOVERY = _faults.FaultPoint("elastic.discovery", exc=RuntimeError)
+
 
 class HostState:
     """Per-host liveness: an event that fires when the host changes or is
@@ -82,6 +89,7 @@ class HostDiscoveryScript(HostDiscovery):
         self._default_slots = default_slots
 
     def find_available_hosts_and_slots(self) -> Dict[str, int]:
+        _FP_DISCOVERY.fire()
         proc = subprocess.run(
             self._script, shell=True, capture_output=True, text=True,
             timeout=60)
@@ -162,6 +170,9 @@ class HostManager:
 
     def is_blacklisted(self, host: str) -> bool:
         return host in self._states and self._states[host].is_blacklisted()
+
+    def blacklisted_count(self) -> int:
+        return sum(1 for s in self._states.values() if s.is_blacklisted())
 
     def get_host_event(self, host: str) -> threading.Event:
         return self._state(host).get_event()

@@ -10,13 +10,13 @@ device mesh; the driver only manages host membership.
 """
 
 import logging
-import os
 import queue
 import threading
 import time
 from collections import defaultdict
 from typing import Callable, Dict, List, Optional, Tuple
 
+from .. import config as _config
 from .. import metrics as _metrics
 from ..runner.hosts import HostInfo, SlotInfo, get_host_assignments
 from .discovery import DiscoveredHosts, HostManager
@@ -38,7 +38,6 @@ _M_RANK_REMOVED = _metrics.counter(
     "Worker slots removed relative to the previous elastic generation.")
 
 DISCOVER_HOSTS_FREQUENCY_SECS = 1.0
-DEFAULT_ELASTIC_TIMEOUT_SECS = 600
 
 log = logging.getLogger("horovod_tpu.elastic")
 
@@ -111,10 +110,11 @@ class ElasticDriver:
         self._host_manager = HostManager(discovery)
         self._min_np = min_np
         self._max_np = max_np
+        # resolved through the knob registry (HVD_TPU_ELASTIC_TIMEOUT /
+        # HOROVOD_ELASTIC_TIMEOUT alias / default) so the launcher-side
+        # driver and the documented config table can never disagree
         self._timeout = timeout or float(
-            os.getenv("HVD_TPU_ELASTIC_TIMEOUT",
-                      os.getenv("HOROVOD_ELASTIC_TIMEOUT",
-                                DEFAULT_ELASTIC_TIMEOUT_SECS)))
+            _config.Config().get(_config.ELASTIC_TIMEOUT))
 
         self._host_assignments: Dict[str, List[SlotInfo]] = {}
         self._rank_assignments: Dict[int, SlotInfo] = {}

@@ -13,6 +13,15 @@ import logging
 import threading
 from typing import Optional, Set, Tuple
 
+from .. import metrics as _metrics
+
+#: Recovery activity, launcher-side: dashboards watch the blacklist gauge
+#: climb and the restart counter (elastic/run.py) tick to see a job
+#: surviving failures — neither is visible from any single worker.
+_M_BLACKLISTED = _metrics.gauge(
+    "hvd_tpu_elastic_blacklisted_hosts",
+    "Hosts currently blacklisted after worker failures.")
+
 READY = "READY"
 SUCCESS = "SUCCESS"
 FAILURE = "FAILURE"
@@ -179,6 +188,7 @@ class WorkerStateRegistry:
         else:
             for host, _slot in self.get(FAILURE):
                 self._host_manager.blacklist(host)
+        _M_BLACKLISTED.set(self._host_manager.blacklisted_count())
         if all(self._host_manager.is_blacklisted(h)
                for h, _ in self.recorded_slots()):
             log.error("elastic: every active host is blacklisted; stopping")

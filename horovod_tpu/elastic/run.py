@@ -40,10 +40,17 @@ import pickle
 import sys
 import tempfile
 
+from .. import config as _config
+from .. import metrics as _metrics
 from ..exceptions import HorovodInternalError, HostsUpdatedInterrupt
 from .worker import notification_manager
 
 log = logging.getLogger("horovod_tpu.elastic")
+
+_M_RESTARTS = _metrics.counter(
+    "hvd_tpu_worker_restarts_total",
+    "Elastic worker resets taken by this process (re-exec into a new "
+    "generation, or in-process shutdown+init outside elastic launches).")
 
 RANK_ENV = ("HVD_TPU_RANK", "HVD_TPU_SIZE", "HVD_TPU_LOCAL_RANK",
             "HVD_TPU_LOCAL_SIZE", "HVD_TPU_CROSS_RANK", "HVD_TPU_CROSS_SIZE")
@@ -138,7 +145,7 @@ def persist_committed_state(state) -> None:
     every batch, where the synchronous pickle+write would dominate step
     time).
     """
-    if os.environ.get("HVD_TPU_ELASTIC_DURABLE_COMMITS", "1") == "0":
+    if not _config.Config().get(_config.ELASTIC_DURABLE_COMMITS):
         return
     path = committed_state_path()
     if not path:
@@ -208,6 +215,11 @@ def maybe_load_persisted_state(state) -> bool:
 def reset(state=None) -> None:
     """Tear down the world and come back up on the new membership."""
     from .. import basics
+    # Counted before the re-exec branch: the counter must tick while this
+    # process can still tick it (the exec'd image starts a fresh registry,
+    # but scrape/snapshot readers see the increment between reset start
+    # and exec).
+    _M_RESTARTS.inc()
     basics.shutdown()
     if not requery_assignment():
         log.info("elastic: this worker has no assignment in the new "

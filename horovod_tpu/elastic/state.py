@@ -11,7 +11,15 @@ the moral equivalent of the reference's GPU->host checkpoint copies.
 import queue
 from typing import Any, Callable, Dict, List, Optional
 
+from .. import faults as _faults
 from ..exceptions import HostsUpdatedInterrupt
+
+# Chaos site for the elastic step loop: one hit per State.commit(), so
+# ``worker.step:crash:step=N`` hard-kills this worker at its N-th commit
+# — the deterministic stand-in for `kill -9` in recovery drills. Fired
+# BEFORE save(), so a crash here loses exactly the uncommitted step (the
+# same contract as a real mid-step kill).
+_FP_STEP = _faults.FaultPoint("worker.step")
 
 
 def _default_bcast_object(obj, root_rank=0, name=None):
@@ -58,6 +66,7 @@ class State:
         self._host_messages.put(timestamp)
 
     def commit(self) -> None:
+        _FP_STEP.fire()
         self.save()
         # Durability on EVERY commit, not just the graceful re-exec path:
         # a worker hard-killed by the runtime (peer-death cascade through

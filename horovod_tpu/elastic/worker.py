@@ -14,10 +14,20 @@ import pickle
 import threading
 from typing import Optional
 
+from .. import faults as _faults
+from .. import retry as _retry
 from ..runner.network import (AckResponse, BasicClient, BasicService,
                               make_secret_key)
 
 PUT_WORKER_ADDRESSES = "worker_addresses"
+
+# Chaos sites for the worker<->driver control channel: registration (the
+# KV put advertising this worker's notification service) and the driver's
+# hosts-updated pushes. Both simulate as transient network failures.
+_FP_REGISTER = _faults.FaultPoint("worker.register",
+                                  exc=_faults.InjectedTransientFault)
+_FP_NOTIFY = _faults.FaultPoint("elastic.notify",
+                                exc=_faults.InjectedTransientFault)
 
 
 class HostsUpdatedRequest:
@@ -45,6 +55,7 @@ class WorkerNotificationClient(BasicClient):
                          timeout=timeout)
 
     def notify_hosts_updated(self, timestamp: float) -> None:
+        _FP_NOTIFY.fire()
         self._send(HostsUpdatedRequest(timestamp))
 
 
@@ -83,10 +94,23 @@ class WorkerNotificationManager:
             self._service = WorkerNotificationService(key, self)
 
             from ..runner.rendezvous import KVStoreClient
-            client = KVStoreClient(rendezvous_addr, rendezvous_port)
+
+            # Registration is the driver's only way to interrupt this
+            # worker on membership changes; a transient blip here must be
+            # retried, not silently drop the worker off the notification
+            # plane. ONE policy owns the budget: the client is built with
+            # max_attempts=1 so its internal rendezvous.put policy cannot
+            # nest inside this one and multiply attempts/deadline.
+            client = KVStoreClient(rendezvous_addr, rendezvous_port,
+                                   retry=_retry.RetryPolicy(max_attempts=1))
             payload = pickle.dumps((self._service.addresses(), key))
-            client.put(PUT_WORKER_ADDRESSES, f"{hostname}:{local_rank}",
-                       payload)
+
+            def register():
+                _FP_REGISTER.fire()
+                client.put(PUT_WORKER_ADDRESSES,
+                           f"{hostname}:{local_rank}", payload)
+            _retry.RetryPolicy.from_config().call(
+                register, site="worker.register")
 
     def register_listener(self, listener) -> None:
         self._listeners.add(listener)

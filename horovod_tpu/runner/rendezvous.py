@@ -19,6 +19,9 @@ from typing import Callable, Dict, Optional, Tuple
 from urllib.request import Request, urlopen
 from urllib.error import HTTPError, URLError
 
+from .. import faults as _faults
+from .. import retry as _retry
+
 
 class _KVHandler(BaseHTTPRequestHandler):
     protocol_version = "HTTP/1.1"
@@ -176,28 +179,55 @@ class RendezvousServer(KVStoreServer):
         return self.port
 
 
+#: Fault points are module-level so every client in the process shares one
+#: deterministic injection schedule per site (the point a chaos spec like
+#: ``rendezvous.get:error:rate=0.3`` addresses). A rendezvous fault looks
+#: like what it simulates: a transient socket error.
+_FP_PUT = _faults.FaultPoint("rendezvous.put",
+                             exc=_faults.InjectedTransientFault)
+_FP_GET = _faults.FaultPoint("rendezvous.get",
+                             exc=_faults.InjectedTransientFault)
+_FP_DELETE = _faults.FaultPoint("rendezvous.delete",
+                                exc=_faults.InjectedTransientFault)
+
+
 class KVStoreClient:
     """Worker-side client (reference common/gloo/http_store.h:34-75:
-    set / get / wait semantics over HTTP)."""
+    set / get / wait semantics over HTTP).
 
-    def __init__(self, addr: str, port: int, timeout: float = 30.0):
+    Every op runs under the shared retry policy (retry.py): the KV store
+    is the first hop of every elastic recovery, so a single congested-
+    coordinator blip must be a backoff, not a dead rendezvous. 404s stay
+    a non-error (``get`` returns None) and are never retried.
+    """
+
+    def __init__(self, addr: str, port: int, timeout: float = 30.0,
+                 retry: Optional[_retry.RetryPolicy] = None):
         self._base = f"http://{addr}:{port}"
         self._timeout = timeout
+        self._retry = retry or _retry.RetryPolicy.from_config()
 
     def put(self, scope: str, key: str, value: bytes):
-        req = Request(f"{self._base}/{scope}/{key}", data=value, method="PUT")
-        with urlopen(req, timeout=self._timeout):
-            pass
+        def attempt():
+            _FP_PUT.fire()
+            req = Request(f"{self._base}/{scope}/{key}", data=value,
+                          method="PUT")
+            with urlopen(req, timeout=self._timeout):
+                pass
+        self._retry.call(attempt, site="rendezvous.put")
 
     def get(self, scope: str, key: str) -> Optional[bytes]:
-        try:
-            with urlopen(f"{self._base}/{scope}/{key}",
-                         timeout=self._timeout) as resp:
-                return resp.read()
-        except HTTPError as e:
-            if e.code == 404:
-                return None
-            raise
+        def attempt():
+            _FP_GET.fire()
+            try:
+                with urlopen(f"{self._base}/{scope}/{key}",
+                             timeout=self._timeout) as resp:
+                    return resp.read()
+            except HTTPError as e:
+                if e.code == 404:
+                    return None
+                raise
+        return self._retry.call(attempt, site="rendezvous.get")
 
     def wait(self, scope: str, key: str, timeout: float = 60.0,
              poll_interval: float = 0.1) -> bytes:
@@ -205,7 +235,9 @@ class KVStoreClient:
         while True:
             try:
                 value = self.get(scope, key)
-            except URLError:
+            except (URLError, ConnectionError):
+                # even after get()'s own retries, wait() keeps polling
+                # until ITS deadline — pre-hardening behavior, kept
                 value = None
             if value is not None:
                 return value
@@ -215,6 +247,9 @@ class KVStoreClient:
             time.sleep(poll_interval)
 
     def delete(self, scope: str, key: str):
-        req = Request(f"{self._base}/{scope}/{key}", method="DELETE")
-        with urlopen(req, timeout=self._timeout):
-            pass
+        def attempt():
+            _FP_DELETE.fire()
+            req = Request(f"{self._base}/{scope}/{key}", method="DELETE")
+            with urlopen(req, timeout=self._timeout):
+                pass
+        self._retry.call(attempt, site="rendezvous.delete")

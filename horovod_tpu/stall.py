@@ -25,6 +25,7 @@ import time
 from typing import Dict
 
 from . import config as _config
+from . import faults as _faults
 from . import metrics as _metrics
 from ._native import get as _native_get
 from .exceptions import StallError
@@ -39,6 +40,12 @@ _M_STALL_SHUTDOWNS = _metrics.counter(
     "hvd_tpu_stall_shutdowns_total",
     "Stall shutdown deadlines hit (StallError raised to waiters).")
 
+# Chaos site: an injected ``error`` here simulates a collective stalled
+# past the shutdown deadline — the inspector translates it into its own
+# failure mode (deadline flag -> StallError at the waiter -> elastic
+# recovery) instead of raising a foreign exception from the daemon thread.
+_FP_DEADLINE = _faults.FaultPoint("stall.deadline")
+
 
 class StallInspector:
     def __init__(self, world):
@@ -51,6 +58,7 @@ class StallInspector:
         self._h = self._nat.cdll.hvd_stall_create() if self._nat else None
         self._stop_evt = threading.Event()
         self._shutdown_deadline_hit = False
+        self._stopped = False
         self._thread = None
         if not self._cfg.get(_config.STALL_CHECK_DISABLE):
             self._thread = threading.Thread(
@@ -65,7 +73,14 @@ class StallInspector:
                 pass
 
     # -- registration --------------------------------------------------------
+    # The native fast path stays LOCK-FREE (the native table has its own
+    # mutex; the submit path pays one ctypes call by design). This is
+    # memory-safe because stop() never destroys the native handle — only
+    # __del__ does, and a submitter thread still holding this inspector
+    # keeps it alive, so a use-after-free is impossible by construction.
     def record_submit(self, name: str):
+        if self._stopped:
+            return
         if self._h is not None:
             self._nat.cdll.hvd_stall_submit(self._h, name.encode())
             return
@@ -73,6 +88,8 @@ class StallInspector:
             self._pending.setdefault(name, time.monotonic())
 
     def record_done(self, name: str):
+        if self._stopped:
+            return
         if self._h is not None:
             self._nat.cdll.hvd_stall_done(self._h, name.encode())
             return
@@ -106,14 +123,21 @@ class StallInspector:
     def _scan(self, warn_after, shutdown_after):
         """One inspection pass; returns newly-stalled names and updates the
         shutdown flag. Native fast path when built."""
+        if self._stopped:
+            return []
         prior_hit = self._shutdown_deadline_hit
+        # the _stopped re-checks below: a pass that was in flight when
+        # stop() ran (e.g. wedged in an injected delay) must not re-arm
+        # the deadline flag stop() just cleared for the next generation
+        if _FP_DEADLINE.check() and not self._stopped:
+            self._shutdown_deadline_hit = True
         if self._h is not None:
             hit = ctypes.c_int32(0)
             buf = ctypes.create_string_buffer(1 << 16)
             n = self._nat.cdll.hvd_stall_check(
                 self._h, float(warn_after), float(shutdown_after),
                 ctypes.byref(hit), buf, len(buf))
-            if hit.value:
+            if hit.value and not self._stopped:
                 self._shutdown_deadline_hit = True
             if self._shutdown_deadline_hit and not prior_hit:
                 _M_STALL_SHUTDOWNS.inc()
@@ -128,13 +152,38 @@ class StallInspector:
             if waited > warn_after and not self._warned.get(name):
                 self._warned[name] = True
                 newly.append(name)
-            if shutdown_after > 0 and waited > shutdown_after:
+            if shutdown_after > 0 and waited > shutdown_after \
+                    and not self._stopped:
                 self._shutdown_deadline_hit = True
         if self._shutdown_deadline_hit and not prior_hit:
             _M_STALL_SHUTDOWNS.inc()
         return newly
 
     def stop(self):
+        """Idempotent teardown, called from ``basics.shutdown()``.
+
+        Stops the poll thread and clears the pending/warned/deadline
+        state: an elastic reset calls ``shutdown(); init()``, and a
+        recovered job must start its new generation with a clean
+        inspector — not immediately re-raising StallError from a stale
+        ``_shutdown_deadline_hit`` (waiters still holding the old
+        inspector poll ``check_shutdown`` between generations). The
+        native handle is deliberately NOT destroyed here: ``__del__``
+        frees it when the last reference drops, so a submitter thread
+        racing an elastic reset can never hit a freed handle, and the
+        record fast path stays lock-free. ``_scan`` re-checks
+        ``_stopped`` before arming the deadline flag, covering a pass
+        still in flight if the join above timed out.
+        """
+        if self._stopped:
+            return
+        self._stopped = True
         self._stop_evt.set()
         if self._thread is not None:
             self._thread.join(timeout=5)
+            if not self._thread.is_alive():
+                self._thread = None
+        with self._lock:
+            self._pending.clear()
+            self._warned.clear()
+        self._shutdown_deadline_hit = False

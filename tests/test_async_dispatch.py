@@ -21,6 +21,11 @@ def _block_dispatcher(w):
     from horovod_tpu import collectives as C
     d = C._dispatcher(w)
     gate, release = threading.Event(), threading.Event()
+    # balance the depth gauge by hand: this put bypasses submit()/
+    # run_sync(), but _run() decrements for every (handle, fn) item it
+    # pops — an unbalanced put leaves the process-global gauge at -1
+    # for every later test
+    C._M_QUEUE_DEPTH.inc()
     d._q.put((None, lambda: (gate.set(), release.wait(30))))
     assert gate.wait(5), "dispatcher thread did not pick up the blocker"
     return release

@@ -86,3 +86,45 @@ def test_cli_runs():
         capture_output=True, text=True, timeout=60)
     assert r.returncode == 0
     assert r.stdout.startswith("steps:")
+
+
+# ---------------------------------------------------------------------------
+# robustness satellites: knob lint + chaos subset are first-class CI suites
+# ---------------------------------------------------------------------------
+
+def test_lint_and_chaos_suites_in_every_service():
+    names = [name for name, _cmd, _t in COMMON_SUITES]
+    assert "lint-knobs" in names
+    assert "chaos" in names
+    by_name = {name: cmd for name, cmd, _t in COMMON_SUITES}
+    assert by_name["lint-knobs"] == "python tools/check_knobs.py"
+    assert "-m chaos" in by_name["chaos"]
+    # and the tool the lint step invokes actually exists
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    assert os.path.exists(os.path.join(root, "tools", "check_knobs.py"))
+
+
+def test_check_knobs_lint_is_clean():
+    """The knob lint must pass on the tree as committed: every HVD_TPU_*
+    env var read in the package is registered in config.py and documented
+    in docs/configuration.md."""
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    r = subprocess.run(
+        [sys.executable, os.path.join(root, "tools", "check_knobs.py")],
+        capture_output=True, text=True, timeout=120)
+    assert r.returncode == 0, r.stdout + r.stderr
+
+
+def test_check_knobs_detects_unregistered_read(tmp_path, monkeypatch):
+    """Seed a stray env read into a scanned copy of the package and the
+    lint must flag it (the tool tests its own teeth)."""
+    sys.path.insert(0, os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "tools"))
+    import check_knobs
+    pkg = tmp_path / "pkg"
+    pkg.mkdir()
+    (pkg / "rogue.py").write_text(
+        'import os\nX = os.environ.get("HVD_TPU_TOTALLY_UNREGISTERED")\n')
+    refs = check_knobs.referenced_vars(str(pkg))
+    assert "HVD_TPU_TOTALLY_UNREGISTERED" in refs
+    assert "HVD_TPU_TOTALLY_UNREGISTERED" not in check_knobs.registered_vars()

@@ -438,6 +438,87 @@ class TestSummary:
         assert agg["min"] == agg["max"] == agg["sum"] == snap[key]
 
 
+class TestRobustnessMetrics:
+    """The fault-injection / retry / recovery series (ISSUE 2): chaos runs
+    must be observable, and recovery activity must be visible launcher-side."""
+
+    def test_fault_injection_counter(self):
+        from horovod_tpu import faults as F
+        key = 'hvd_tpu_faults_injected_total{site="mtest.site",kind="delay"}'
+        before = _series(key)
+        F.configure("mtest.site:delay=0.0", seed=1)
+        try:
+            F.FaultPoint("mtest.site").fire()
+        finally:
+            F.configure("", seed=0)
+        assert _series(key) - before == 1
+
+    def test_retry_attempt_and_exhausted_counters(self):
+        from horovod_tpu import retry as R
+        a_key = 'hvd_tpu_retry_attempts_total{site="mtest.retry"}'
+        a0 = _series(a_key)
+        x0 = _series("hvd_tpu_retry_exhausted_total")
+        pol = R.RetryPolicy(max_attempts=3, initial_backoff=0.0,
+                            sleep=lambda s: None)
+        with pytest.raises(ConnectionError):
+            pol.call(lambda: (_ for _ in ()).throw(ConnectionError("x")),
+                     site="mtest.retry")
+        assert _series(a_key) - a0 == 2          # retries, not first call
+        assert _series("hvd_tpu_retry_exhausted_total") - x0 == 1
+
+    def test_blacklisted_hosts_gauge_moves_on_failure(self):
+        """Registry barrier action blacklists the failing host and updates
+        the gauge (driver simulation, no processes — test_elastic.py
+        pattern)."""
+        import time as _t
+
+        from horovod_tpu.elastic.discovery import FixedHosts
+        from horovod_tpu.elastic.driver import ElasticDriver
+
+        class _Rdv:
+            def init(self, a):
+                pass
+
+            def stop(self):
+                pass
+
+        key = "hvd_tpu_elastic_blacklisted_hosts"
+        driver = ElasticDriver(_Rdv(), FixedHosts({"h1": 1, "h2": 1}),
+                               min_np=1, max_np=2, timeout=10)
+
+        def create_worker(slot_info, events):
+            if slot_info.hostname == "h2":
+                return 1, _t.time()
+            driver.record_ready("h1", 0)
+            return 0, _t.time()
+
+        driver.start(2, create_worker)
+        driver.get_results()
+        assert driver._host_manager.is_blacklisted("h2")
+        # gauge reflects the CURRENT count for this driver's job
+        assert _series(key) == 1
+        driver.stop()
+
+    def test_worker_restarts_counter(self, monkeypatch):
+        """reset() outside an elastic launch (in-process shutdown+init)
+        ticks hvd_tpu_worker_restarts_total."""
+        import horovod_tpu as hvd
+        from horovod_tpu.elastic.run import reset
+
+        for var in ("HVD_TPU_ELASTIC", "HVD_TPU_RENDEZVOUS_ADDR"):
+            monkeypatch.delenv(var, raising=False)
+        key = "hvd_tpu_worker_restarts_total"
+        before = _series(key)
+        if hvd.is_initialized():
+            hvd.shutdown()
+        hvd.init()
+        try:
+            reset()
+        finally:
+            hvd.shutdown()
+        assert _series(key) - before == 1
+
+
 @pytest.mark.integration
 @pytest.mark.parametrize("n", [2, 4])
 def test_multiprocess_metrics(n):
