@@ -28,7 +28,14 @@ COMMON_SUITES = [
     # would run twice per service
     ("unit",
      "python -m pytest tests/ -q -m 'not integration and not chaos'", 30),
-    ("chaos", "python -m pytest tests/ -q -m chaos", 20),
+    ("chaos", "python -m pytest tests/ -q -m chaos "
+     "--ignore=tests/test_coordinator_recovery.py", 20),
+    # coordinator-kill + heartbeat-timeout drills, seeded so every run
+    # replays the same fault schedule; owns its test file exclusively
+    # (the generic chaos suite ignores it to avoid double runs)
+    ("chaos-coordinator",
+     "env HVD_TPU_FAULT_SEED=1234 "
+     "python -m pytest tests/test_coordinator_recovery.py -q", 30),
     ("multiproc",
      "python -m pytest tests/test_multiprocess_integration.py -q", 30),
     ("elastic", "python -m pytest tests/test_elastic_e2e.py -q", 40),

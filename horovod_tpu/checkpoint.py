@@ -18,11 +18,20 @@ Restored arrays can be re-staged onto a target sharding (mesh topology may
 differ across restarts — the elastic resume case).
 """
 
+import logging
 import os
 import re
 from typing import Any, Optional
 
+from . import metrics as _metrics
 from .callbacks import Callback
+
+log = logging.getLogger("horovod_tpu.checkpoint")
+
+_M_FALLBACKS = _metrics.counter(
+    "hvd_tpu_checkpoint_fallbacks_total",
+    "restore(fallback=True) calls that skipped a corrupt/partial latest "
+    "checkpoint and restored an earlier completed step instead.")
 
 # completed checkpoints only: orbax writes to
 # "step_<n>.orbax-checkpoint-tmp-<ts>" before renaming, and a crashed save
@@ -64,29 +73,75 @@ def save(directory: str, step: int, tree: Any, force: bool = False) -> str:
 
 
 def restore(directory: str, step: Optional[int] = None, target: Any = None,
-            sharding=None) -> Any:
+            sharding=None, fallback: bool = False) -> Any:
     """Restore the pytree saved at ``step`` (default: latest). ``target``
     (optional) provides structure/dtypes; ``sharding`` re-stages leaves
-    onto a mesh after restore (elastic resume onto a resized mesh)."""
+    onto a mesh after restore (elastic resume onto a resized mesh).
+
+    ``fallback=True`` (opt-in): when the selected step is corrupt or
+    partial — a crash can rename an orbax dir and die before the contents
+    are complete — walk back to the previous completed step instead of
+    raising, logging each skip and counting
+    ``hvd_tpu_checkpoint_fallbacks_total``. Only the *final* candidate's
+    error propagates; a job with one good checkpoint always resumes.
+    """
     if step is None:
-        step = latest_step(directory)
-        if step is None:
+        candidates = _steps(directory)
+        if not candidates:
             raise FileNotFoundError(
                 f"no checkpoints under {directory!r}")
-    tree = _checkpointer().restore(_step_dir(directory, step), item=target)
-    if sharding is not None:
-        import jax
-        tree = jax.device_put(tree, sharding)
-    return tree
+    elif fallback:
+        candidates = [s for s in _steps(directory) if s <= step]
+        if not candidates:
+            raise FileNotFoundError(
+                f"no checkpoints at or before step {step} under "
+                f"{directory!r}")
+    else:
+        candidates = [step]
+    if not fallback:
+        candidates = candidates[:1]
+    # A requested step that does not exist at all is itself a fallback:
+    # resuming from older weights must never be silent.
+    fell_back = step is not None and fallback and candidates[0] != step
+    if fell_back:
+        log.warning(
+            "checkpoint: step %d does not exist under %s; falling back to "
+            "step %d", step, directory, candidates[0])
+    for i, cand in enumerate(candidates):
+        try:
+            tree = _checkpointer().restore(_step_dir(directory, cand),
+                                           item=target)
+        except Exception as e:  # noqa: BLE001 — orbax raises various types
+            if i + 1 >= len(candidates):
+                raise
+            log.warning(
+                "checkpoint: step %d under %s is corrupt or partial (%s); "
+                "falling back to step %d", cand, directory, e,
+                candidates[i + 1])
+            fell_back = True
+            continue
+        if fell_back:
+            _M_FALLBACKS.inc()
+        if sharding is not None:
+            import jax
+            tree = jax.device_put(tree, sharding)
+        return tree
+
+
+def _steps(directory: str):
+    """Completed step numbers under ``directory``, newest first (the one
+    scan restore's fallback walk and latest_step both derive from)."""
+    try:
+        names = os.listdir(directory)
+    except FileNotFoundError:
+        return []
+    return sorted((int(m.group(1)) for name in names
+                   if (m := _STEP_RE.match(name))), reverse=True)
 
 
 def latest_step(directory: str) -> Optional[int]:
-    try:
-        steps = [int(m.group(1)) for name in os.listdir(directory)
-                 if (m := _STEP_RE.match(name))]
-    except FileNotFoundError:
-        return None
-    return max(steps) if steps else None
+    steps = _steps(directory)
+    return steps[0] if steps else None
 
 
 class CheckpointCallback(Callback):

@@ -202,6 +202,22 @@ RENDEZVOUS_PORT = _register(
 RENDEZVOUS_ADDR = _register(
     "RENDEZVOUS_ADDR", "", str,
     help="Host of the launcher's HTTP KV rendezvous server.")
+RENDEZVOUS_DIR = _register(
+    "RENDEZVOUS_DIR", "", str,
+    help="Directory for the KV rendezvous store's durable write-ahead "
+         "journal + periodic snapshots. Empty (default) keeps the store "
+         "in-memory only (the coordinator is then a single point of "
+         "failure); set it to make the host plane crash-recoverable: a "
+         "restarted coordinator replays snapshot+journal, bumps its "
+         "epoch, and workers re-register instead of wedging on stale "
+         "scoped keys (docs/robustness.md).")
+RENDEZVOUS_SNAPSHOT_EVERY = _register(
+    "RENDEZVOUS_SNAPSHOT_EVERY", 256, int,
+    help="Journal appends between snapshot compactions of the rendezvous "
+         "journal (HVD_TPU_RENDEZVOUS_DIR). Each compaction writes a full "
+         "snapshot atomically and truncates the journal, bounding replay "
+         "time after a coordinator crash. 0 disables compaction (the "
+         "journal grows for the life of the job).")
 ELASTIC = _register("ELASTIC", False, _parse_bool, alias="HOROVOD_ELASTIC")
 ELASTIC_TIMEOUT = _register(
     "ELASTIC_TIMEOUT", 600.0, float, alias="HOROVOD_ELASTIC_TIMEOUT",
@@ -229,6 +245,24 @@ HEARTBEAT_TIMEOUT_SECONDS = _register(
 SHUTDOWN_TIMEOUT_SECONDS = _register(
     "SHUTDOWN_TIMEOUT_SECONDS", 60.0, float,
     help="JAX coordination-service shutdown barrier timeout.")
+HEARTBEAT_INTERVAL = _register(
+    "HEARTBEAT_INTERVAL", 5.0, float,
+    help="Seconds between host-plane heartbeat PUTs from each elastic "
+         "worker to the rendezvous KV store (scope 'heartbeat'). 0 "
+         "disables the heartbeat/liveness layer. Distinct from "
+         "HVD_TPU_HEARTBEAT_TIMEOUT_SECONDS, which tunes the JAX "
+         "data-plane coordination service: this layer lets the *launcher* "
+         "detect a silently-hung worker (process alive, not "
+         "participating) and blacklist its host without waiting for a "
+         "stall deadline.")
+HEARTBEAT_TIMEOUT = _register(
+    "HEARTBEAT_TIMEOUT", 60.0, float,
+    help="Seconds without a heartbeat after which the elastic driver "
+         "declares a worker's host dead and triggers the existing "
+         "blacklist -> re-rendezvous flow. Detection is bounded by "
+         "timeout + one monitor poll (< 2x this value). Only armed once "
+         "a worker's first beat arrives, and cleared per generation, so "
+         "slow startups and re-execs are never misdeclared.")
 
 # -- Consistency checking (replaces the reference controller's per-cycle
 #    dtype/shape validation, controller.cc:378-611) --------------------------

@@ -168,6 +168,14 @@ class HostManager:
     def blacklist(self, host: str) -> None:
         self._state(host).blacklist()
 
+    def fire_host_event(self, host: str) -> None:
+        """Fire the host's change event WITHOUT blacklisting it — how the
+        heartbeat monitor kills a silently-wedged worker so its exit flows
+        through the normal FAILURE -> blacklist path (a pre-kill blacklist
+        would make the registry skip the exit record and hang the
+        generation barrier)."""
+        self._state(host).set_event()
+
     def is_blacklisted(self, host: str) -> bool:
         return host in self._states and self._states[host].is_blacklisted()
 

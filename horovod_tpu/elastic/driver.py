@@ -20,8 +20,14 @@ from .. import config as _config
 from .. import metrics as _metrics
 from ..runner.hosts import HostInfo, SlotInfo, get_host_assignments
 from .discovery import DiscoveredHosts, HostManager
+from .heartbeat import HeartbeatMonitor
 from .registration import WorkerStateRegistry
-from .worker import WorkerNotificationClient
+from .worker import PUT_WORKER_ADDRESSES, WorkerNotificationClient
+
+#: rendezvous scope persisting blacklisted hostnames — journaled with the
+#: rest of the store, so a restarted coordinator does not re-run doomed
+#: hosts it already learned about
+BLACKLIST_SCOPE = "blacklist"
 
 # Elastic membership events as counters: a flapping host shows up as a
 # climbing add/remove rate on the driver's scrape, which no single worker
@@ -132,6 +138,14 @@ class ElasticDriver:
         self._results = ResultsRecorder()
         self._shutdown = threading.Event()
 
+        # Heartbeat liveness: beats observed via the rendezvous PUT handler
+        # (elastic/rendezvous.py) feed the monitor; a silent slot past the
+        # timeout gets its host event fired, which kills the wedged process
+        # and lets the normal exit path drive blacklist + re-rendezvous.
+        self._heartbeat_monitor = HeartbeatMonitor(
+            on_dead=self._on_heartbeat_timeout)
+        self._heartbeat_monitor.start()
+
         self._discovery_thread = threading.Thread(
             target=self._discover_hosts, name="hvd-elastic-discovery",
             daemon=True)
@@ -159,6 +173,7 @@ class ElasticDriver:
     def stop(self, error_message: Optional[str] = None) -> None:
         self._results.set_error_message(error_message)
         self._shutdown.set()
+        self._heartbeat_monitor.stop()
         with self._wait_hosts_cond:
             self._wait_hosts_cond.notify_all()
         if self._rendezvous is not None:
@@ -184,6 +199,60 @@ class ElasticDriver:
 
     def record_ready(self, host: str, slot: int) -> None:
         self._worker_registry.record_ready(host, slot)
+
+    # -- liveness / blacklist ------------------------------------------------
+    def record_heartbeat(self, key: str, value: bytes) -> None:
+        """PUT handler for the ``heartbeat`` scope (elastic/rendezvous.py)."""
+        self._heartbeat_monitor.observe(key, value)
+
+    def _on_heartbeat_timeout(self, host: str, slot: int, rank) -> None:
+        if self.finished() or not self.has_rank_assignment(host, slot):
+            return   # already gone: blacklisted, stale generation, shutdown
+        # Fire (don't blacklist): the watcher kills the wedged process, its
+        # nonzero exit records FAILURE, and the registry blacklists the
+        # host on the barrier — one recovery path for every death signal.
+        self._host_manager.fire_host_event(host)
+
+    def blacklist_host(self, host: str) -> None:
+        """Blacklist ``host`` and persist the fact to the rendezvous so a
+        journal-restarted coordinator re-seeds it (restore_from_rendezvous)
+        instead of re-running a host it already knows is bad."""
+        self._host_manager.blacklist(host)
+        try:
+            self._rendezvous.put(BLACKLIST_SCOPE, host, b"1")
+        except Exception:
+            log.debug("elastic: could not persist blacklist entry for %s",
+                      host, exc_info=True)
+
+    def restore_from_rendezvous(self) -> int:
+        """Re-seed driver state from a journal-restored KV store: worker
+        notification addresses and the blacklist. Called by the launcher
+        after ``attach_elastic_handlers`` when the rendezvous came back
+        from disk (coordinator hot-restart path); a fresh store holds
+        nothing and this is a no-op. Returns the number of re-seeded
+        entries."""
+        import pickle
+
+        count = 0
+        for host in self._rendezvous.items(BLACKLIST_SCOPE):
+            if not self._host_manager.is_blacklisted(host):
+                self._host_manager.blacklist(host)
+                count += 1
+        for key, blob in self._rendezvous.items(PUT_WORKER_ADDRESSES).items():
+            host, _, local_rank = key.rpartition(":")
+            try:
+                addresses, secret_key = pickle.loads(blob)
+                self.register_worker_server(host, int(local_rank),
+                                            addresses, secret_key)
+                count += 1
+            except Exception:
+                log.warning("elastic: stale worker-address entry %r not "
+                            "restored", key, exc_info=True)
+        if count:
+            log.warning("elastic: re-seeded %d registry/blacklist entr%s "
+                        "from the restored rendezvous", count,
+                        "y" if count == 1 else "ies")
+        return count
 
     # -- assignment queries --------------------------------------------------
     def world_size(self) -> int:
@@ -236,6 +305,10 @@ class ElasticDriver:
         pending = self._update_host_assignments(current,
                                                 respawn_all=respawn_all)
         self._worker_registry.reset(self.world_size())
+        # Liveness restarts with the generation: old beats (and old
+        # silences — e.g. a worker that spent the formation re-exec'ing)
+        # say nothing about the new membership.
+        self._heartbeat_monitor.reset()
         for slot_info in pending:
             self._start_worker_process(slot_info)
 
@@ -357,6 +430,10 @@ class ElasticDriver:
 
     def _handle_worker_exit(self, slot_info: SlotInfo, exit_code: int,
                             timestamp: float) -> None:
+        # An exited worker's silence is expected; a stale declaration must
+        # never fire a host event into a successor generation's worker.
+        self._heartbeat_monitor.forget(slot_info.hostname,
+                                       slot_info.local_rank)
         if not self.has_rank_assignment(slot_info.hostname,
                                         slot_info.local_rank):
             return  # blacklisted or stale generation

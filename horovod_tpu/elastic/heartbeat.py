@@ -1,0 +1,223 @@
+"""Host-plane heartbeat/liveness layer for elastic jobs.
+
+The reference (and PR 2's hardening) detects worker death two ways: the
+launcher's per-worker runner observes the process *exit*, or the JAX
+coordination service times out a peer inside a collective. Neither covers
+a worker that is silently wedged — process alive, not participating, not
+exiting — which otherwise stalls the job until the stall-inspector
+shutdown deadline (870s-scale) fires.
+
+This module closes that gap with a third signal that needs no data-plane
+cooperation:
+
+* :class:`HeartbeatSender` (worker side) PUTs a per-rank beat to the
+  rendezvous KV store (scope ``heartbeat``, key ``hostname:local_rank``)
+  every ``HVD_TPU_HEARTBEAT_INTERVAL`` seconds. Beats ride the same
+  KV channel as registration, so they also keep the client's coordinator-
+  epoch view fresh (a restarted coordinator is noticed within one
+  interval, triggering re-registration).
+* :class:`HeartbeatMonitor` (driver side) records each beat's *receipt*
+  time — launcher clock only, so worker clock skew cannot misdeclare —
+  and declares a slot dead after ``HVD_TPU_HEARTBEAT_TIMEOUT`` seconds of
+  silence. Declaration fires the host's change event, which kills the
+  wedged worker process through the existing watcher, whose nonzero exit
+  then drives the normal FAILURE -> blacklist -> re-rendezvous flow. No
+  new recovery machinery: the monitor only converts silence into the
+  signal the recovery path already understands.
+
+A slot is only armed once its first beat arrives and tracking is cleared
+on every generation reset and worker exit, so slow startups, re-execs and
+already-recorded failures are never declared dead.
+
+Chaos site ``heartbeat.miss``: fired on the worker's send path; an
+injected error suppresses the beat (the wedged-worker simulation the
+PR 2 grammar can schedule deterministically).
+"""
+
+import logging
+import threading
+import time
+from typing import Callable, Dict, Optional, Tuple
+
+from .. import config as _config
+from .. import faults as _faults
+from .. import metrics as _metrics
+
+log = logging.getLogger("horovod_tpu.elastic")
+
+HEARTBEAT_SCOPE = "heartbeat"
+
+_FP_MISS = _faults.FaultPoint("heartbeat.miss",
+                              exc=_faults.InjectedTransientFault)
+
+_M_MISSES = _metrics.counter(
+    "hvd_tpu_heartbeat_misses_total",
+    "Workers declared dead by the driver's heartbeat monitor (no beat "
+    "within HVD_TPU_HEARTBEAT_TIMEOUT), by last-known rank.",
+    labels=("rank",))
+
+
+def heartbeat_key(hostname: str, local_rank) -> str:
+    return f"{hostname}:{local_rank}"
+
+
+class HeartbeatSender:
+    """Worker-side beat loop (daemon thread).
+
+    ``client`` is a KVStoreClient; beats are strictly best-effort — a
+    failed PUT is skipped, not retried beyond the client's own policy,
+    because the next interval is a retry by construction and a beat that
+    arrives late is worse than one that is simply missing.
+    """
+
+    def __init__(self, client, hostname: str, local_rank, rank,
+                 interval: Optional[float] = None):
+        self._client = client
+        self._key = heartbeat_key(hostname, local_rank)
+        self._rank = rank
+        self._interval = interval if interval is not None else float(
+            _config.Config().get(_config.HEARTBEAT_INTERVAL))
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> None:
+        if self._interval <= 0 or self._thread is not None:
+            return
+        self._thread = threading.Thread(
+            target=self._loop, name="hvd-heartbeat-sender", daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        thread, self._thread = self._thread, None
+        if thread is not None:
+            thread.join(timeout=2)
+
+    def beat_once(self) -> bool:
+        """One beat; True when it reached the store."""
+        try:
+            _FP_MISS.fire()
+            self._client.put(HEARTBEAT_SCOPE, self._key,
+                             str(self._rank).encode())
+            return True
+        except Exception:
+            # includes injected heartbeat.miss faults: a wedged worker
+            # doesn't log its own wedging either
+            log.debug("elastic: heartbeat for %s not delivered", self._key,
+                      exc_info=True)
+            return False
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            self.beat_once()
+            self._stop.wait(self._interval)
+
+
+class HeartbeatMonitor:
+    """Driver-side liveness bookkeeping + declaration thread.
+
+    ``on_dead(host, slot, rank)`` runs on the monitor thread when a slot
+    armed by a first beat goes silent past the timeout. The driver passes
+    a callback that fires the host event (kill -> exit -> FAILURE ->
+    blacklist), keeping recovery single-pathed.
+    """
+
+    def __init__(self, on_dead: Callable[[str, int, str], None],
+                 timeout: Optional[float] = None,
+                 poll_interval: Optional[float] = None):
+        cfg = _config.Config()
+        self._on_dead = on_dead
+        self._timeout = timeout if timeout is not None else float(
+            cfg.get(_config.HEARTBEAT_TIMEOUT))
+        # poll at the beat interval: detection latency is then bounded by
+        # timeout + interval < 2 x timeout for any sane interval
+        self._poll = poll_interval if poll_interval is not None else max(
+            0.1, float(cfg.get(_config.HEARTBEAT_INTERVAL)))
+        # A timeout at or below the beat interval would declare perfectly
+        # healthy workers dead between beats, thrashing the blacklist
+        # until the cluster is exhausted — clamp to 2x the interval so a
+        # single dropped beat never kills a worker either.
+        floor = 2.0 * self._poll
+        if 0 < self._timeout < floor:
+            log.warning(
+                "elastic: HVD_TPU_HEARTBEAT_TIMEOUT (%.1fs) is below 2x "
+                "the heartbeat interval; clamping to %.1fs",
+                self._timeout, floor)
+            self._timeout = floor
+        self._lock = threading.Lock()
+        #: (host, slot) -> (last receipt monotonic, last reported rank)
+        self._beats: Dict[Tuple[str, int], Tuple[float, str]] = {}
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> None:
+        if self._timeout <= 0 or self._thread is not None:
+            return
+        self._thread = threading.Thread(
+            target=self._loop, name="hvd-heartbeat-monitor", daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        thread, self._thread = self._thread, None
+        if thread is not None:
+            thread.join(timeout=2)
+
+    # -- bookkeeping (driver/rendezvous callbacks) ---------------------------
+    def observe(self, key: str, value: bytes) -> None:
+        """Record a beat's receipt (wired as the ``heartbeat`` scope's PUT
+        handler). The key is ``hostname:local_rank``; the value is the
+        worker's rank, used only to label the miss counter."""
+        host, _, local_rank = key.rpartition(":")
+        try:
+            slot = int(local_rank)
+        except ValueError:
+            return
+        rank = value.decode(errors="replace") if value else "?"
+        with self._lock:
+            self._beats[(host, slot)] = (time.monotonic(), rank)
+
+    def forget(self, host: str, slot: int) -> None:
+        """Drop a slot (its worker exited — silence is now expected)."""
+        with self._lock:
+            self._beats.pop((host, slot), None)
+
+    def reset(self) -> None:
+        """New generation: nothing already observed still applies."""
+        with self._lock:
+            self._beats.clear()
+
+    def last_beat_age(self, host: str, slot: int) -> Optional[float]:
+        with self._lock:
+            entry = self._beats.get((host, slot))
+        return None if entry is None else time.monotonic() - entry[0]
+
+    # -- declaration ---------------------------------------------------------
+    def check_now(self) -> None:
+        """One declaration sweep (the thread loop body; callable directly
+        from tests for deterministic timing)."""
+        now = time.monotonic()
+        with self._lock:
+            dead = [(host, slot, rank)
+                    for (host, slot), (t, rank) in self._beats.items()
+                    if now - t > self._timeout]
+            for host, slot, _rank in dead:
+                del self._beats[(host, slot)]
+        for host, slot, rank in dead:
+            _M_MISSES.labels(rank=rank).inc()
+            log.warning(
+                "elastic: no heartbeat from %s[%s] (rank %s) for more than "
+                "%.1fs; declaring it dead and triggering blacklist/"
+                "re-rendezvous", host, slot, rank, self._timeout)
+            try:
+                self._on_dead(host, slot, rank)
+            except Exception:
+                log.exception("elastic: heartbeat-death handler failed "
+                              "for %s[%s]", host, slot)
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            self._stop.wait(self._poll)
+            if self._stop.is_set():
+                return
+            self.check_now()

@@ -87,6 +87,16 @@ def _make_create_worker_fn(command, rendezvous, rendezvous_addr: str,
 def launch_elastic(args) -> int:
     """Run an elastic job from parsed ``horovodrun-tpu`` args
     (reference launch.py:574 _run_elastic)."""
+    # These knobs steer the LAUNCHER process (journal on its KV store,
+    # heartbeat monitor on its driver), not only workers, so CLI values
+    # must land in this process's env before any Config() resolves them;
+    # set_env_from_args below then propagates the same values to workers.
+    for flag, var in (("rendezvous_dir", "HVD_TPU_RENDEZVOUS_DIR"),
+                      ("heartbeat_interval", "HVD_TPU_HEARTBEAT_INTERVAL"),
+                      ("heartbeat_timeout", "HVD_TPU_HEARTBEAT_TIMEOUT")):
+        value = getattr(args, flag, None)
+        if value is not None and value != "":
+            os.environ[var] = str(value)
     if args.host_discovery_script:
         discovery = HostDiscoveryScript(args.host_discovery_script,
                                         default_slots=args.slots or 1)
@@ -103,12 +113,24 @@ def launch_elastic(args) -> int:
     max_np = args.max_np
 
     rendezvous = RendezvousServer(verbose=args.verbose)
+    # Before start(): on a hot-restart the store rebinds the previous
+    # incarnation's persisted port immediately, and surviving workers'
+    # beats must not be fsync-journaled as permanent state in the window
+    # before attach_elastic_handlers runs.
+    from .heartbeat import HEARTBEAT_SCOPE
+    rendezvous.ephemeral_scopes.add(HEARTBEAT_SCOPE)
     rendezvous.start()
 
     driver = ElasticDriver(
         rendezvous, discovery, min_np=min_np, max_np=max_np,
         timeout=args.elastic_timeout, reset_limit=args.reset_limit)
     attach_elastic_handlers(rendezvous, driver)
+    if rendezvous.replayed_entries:
+        # Coordinator hot-restart: the KV store came back from its journal
+        # (HVD_TPU_RENDEZVOUS_DIR), so this launcher is a restart, not a
+        # fresh job — re-seed the driver's worker registry and blacklist
+        # from the restored state instead of starting blind.
+        driver.restore_from_rendezvous()
 
     # The elastic membership counters (driver.py) live in THIS process,
     # not in any worker, so the launcher serves its own scrape endpoint

@@ -144,6 +144,15 @@ class WorkerStateRegistry:
                             f"elastic worker state {state} overridden by "
                             f"{saved}") from None
 
+    def _blacklist(self, host: str) -> None:
+        # Through the driver when it has the persistent path (rendezvous-
+        # journaled blacklist survives coordinator restarts); the plain
+        # host-manager call keeps driver-less unit doubles working.
+        if hasattr(self._driver, "blacklist_host"):
+            self._driver.blacklist_host(host)
+        else:
+            self._host_manager.blacklist(host)
+
     # -- barrier action (runs on the last arriving thread) -------------------
     def _on_all_recorded(self):
         if self.count(SUCCESS) > 0:
@@ -183,11 +192,11 @@ class WorkerStateRegistry:
                 "rooted at %s[%s] (first failure) — blacklisting %s and "
                 "respawning the surviving hosts %s",
                 self._size, root[0], root[1], root[0], survivors)
-            self._host_manager.blacklist(root[0])
+            self._blacklist(root[0])
             respawn_all = True
         else:
             for host, _slot in self.get(FAILURE):
-                self._host_manager.blacklist(host)
+                self._blacklist(host)
         _M_BLACKLISTED.set(self._host_manager.blacklisted_count())
         if all(self._host_manager.is_blacklisted(h)
                for h, _ in self.recorded_slots()):

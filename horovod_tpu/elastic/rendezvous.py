@@ -10,6 +10,7 @@ register the worker's notification service with the driver.
 import pickle
 
 from ..runner.rendezvous import RendezvousServer
+from .heartbeat import HEARTBEAT_SCOPE
 from .worker import PUT_WORKER_ADDRESSES
 
 GET_RANK_AND_SIZE = "rank_and_size"
@@ -38,3 +39,8 @@ def attach_elastic_handlers(rendezvous: RendezvousServer, driver) -> None:
 
     rendezvous.add_handler(GET_RANK_AND_SIZE, get_rank_and_size)
     rendezvous.add_put_handler(PUT_WORKER_ADDRESSES, put_worker_addresses)
+    record_heartbeat = getattr(driver, "record_heartbeat", None)
+    if record_heartbeat is not None:   # unit-test driver doubles may lack it
+        rendezvous.add_put_handler(HEARTBEAT_SCOPE, record_heartbeat)
+    # liveness is only meaningful live: never journal or snapshot beats
+    rendezvous.ephemeral_scopes.add(HEARTBEAT_SCOPE)

@@ -9,6 +9,7 @@ secret to the launcher through the rendezvous KV store
 (scope ``worker_addresses``, key ``hostname:local_rank``).
 """
 
+import logging
 import os
 import pickle
 import threading
@@ -18,6 +19,9 @@ from .. import faults as _faults
 from .. import retry as _retry
 from ..runner.network import (AckResponse, BasicClient, BasicService,
                               make_secret_key)
+from .heartbeat import HeartbeatSender
+
+log = logging.getLogger("horovod_tpu.elastic")
 
 PUT_WORKER_ADDRESSES = "worker_addresses"
 
@@ -66,6 +70,7 @@ class WorkerNotificationManager:
         self._lock = threading.Lock()
         self._service: Optional[WorkerNotificationService] = None
         self._listeners = set()
+        self._heartbeat: Optional[HeartbeatSender] = None
 
     def init(self, rendezvous_addr: Optional[str] = None,
              rendezvous_port: Optional[int] = None,
@@ -109,8 +114,36 @@ class WorkerNotificationManager:
                 _FP_REGISTER.fire()
                 client.put(PUT_WORKER_ADDRESSES,
                            f"{hostname}:{local_rank}", payload)
-            _retry.RetryPolicy.from_config().call(
-                register, site="worker.register")
+
+            def register_with_retries():
+                _retry.RetryPolicy.from_config().call(
+                    register, site="worker.register")
+
+            # A coordinator epoch bump means the KV store restarted: any
+            # scoped key the old incarnation lost (most critically our
+            # notification address — the driver's only way to interrupt
+            # this worker) must be re-registered, under the same retry
+            # policy as first registration, instead of wedging on stale
+            # state. The bump is observed on whatever op touches the
+            # store next — in steady state, the next heartbeat PUT.
+            def on_epoch_bump(old, new):
+                log.warning(
+                    "elastic: coordinator epoch bumped %d -> %d "
+                    "(rendezvous restarted); re-registering this worker",
+                    old, new)
+                register_with_retries()
+            client.on_epoch_bump = on_epoch_bump
+
+            register_with_retries()
+
+            # Per-rank liveness beats over the same client/channel. Rank
+            # comes from the launch env; heartbeats pre-date init() so the
+            # world may not exist yet.
+            rank = os.environ.get("HVD_TPU_RANK",
+                                  os.environ.get("HOROVOD_RANK", "?"))
+            self._heartbeat = HeartbeatSender(client, hostname, local_rank,
+                                              rank)
+            self._heartbeat.start()
 
     def register_listener(self, listener) -> None:
         self._listeners.add(listener)
@@ -124,6 +157,9 @@ class WorkerNotificationManager:
 
     def shutdown(self) -> None:
         with self._lock:
+            if self._heartbeat:
+                self._heartbeat.stop()
+                self._heartbeat = None
             if self._service:
                 self._service.shutdown()
                 self._service = None

@@ -308,11 +308,19 @@ class FaultPoint:
                     self._gen = reg.gen
         return self._bound
 
-    def fire(self) -> None:
-        """Inject any matching faults; raises / sleeps / exits per kind."""
+    def fire(self, crash: Optional[Callable[[], None]] = None) -> None:
+        """Inject any matching faults; raises / sleeps / exits per kind.
+
+        ``crash``: optional site-owned substitute for ``os._exit`` on
+        ``crash`` faults. A worker-side site has nothing gentler than a
+        hard process kill, but a *launcher*-side site (the rendezvous
+        server) must simulate its component dying without taking the
+        whole job control plane down with it — the owner passes the
+        simulation (e.g. ``KVStoreServer._simulate_crash``) here.
+        """
         if _ACTIVE is None and _configured:
             return  # hot path: injection off
-        err = self._evaluate()
+        err = self._evaluate(crash=crash)
         if err is not None:
             raise err
 
@@ -324,7 +332,8 @@ class FaultPoint:
             return False
         return self._evaluate() is not None
 
-    def _evaluate(self) -> Optional[BaseException]:
+    def _evaluate(self, crash: Optional[Callable[[], None]] = None
+                  ) -> Optional[BaseException]:
         if not _configured:
             configure()
         reg = _ACTIVE   # one read: rules + seed + gen stay consistent
@@ -343,6 +352,9 @@ class FaultPoint:
             if rule.kind in ("delay", "hang"):
                 time.sleep(rule.seconds)
             elif rule.kind == "crash":
+                if crash is not None:
+                    crash()
+                    continue
                 import sys
                 sys.stdout.flush()
                 sys.stderr.flush()

@@ -29,6 +29,9 @@ _ARG_TO_KNOB = {
     "verbose_log_level": _config.LOG_LEVEL,
     "check_consistency": _config.CHECK_CONSISTENCY,
     "start_timeout": _config.INIT_TIMEOUT_SECONDS,
+    "rendezvous_dir": _config.RENDEZVOUS_DIR,
+    "heartbeat_interval": _config.HEARTBEAT_INTERVAL,
+    "heartbeat_timeout": _config.HEARTBEAT_TIMEOUT,
 }
 
 _MB_ARGS = {"fusion_threshold_mb"}

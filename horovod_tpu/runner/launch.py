@@ -159,6 +159,23 @@ def make_parser() -> argparse.ArgumentParser:
     g.add_argument("--elastic-timeout", type=float, default=None,
                    dest="elastic_timeout")
     g.add_argument("--reset-limit", type=int, default=None, dest="reset_limit")
+    g.add_argument("--rendezvous-dir", default=None, dest="rendezvous_dir",
+                   help="Directory for the rendezvous KV store's durable "
+                        "journal + snapshots (HVD_TPU_RENDEZVOUS_DIR). A "
+                        "coordinator restarted against the same directory "
+                        "replays its state and bumps the epoch so workers "
+                        "re-register instead of wedging; unset keeps the "
+                        "store memory-only.")
+    g.add_argument("--heartbeat-interval", type=float, default=None,
+                   dest="heartbeat_interval",
+                   help="Seconds between worker liveness beats to the "
+                        "rendezvous (HVD_TPU_HEARTBEAT_INTERVAL; 0 "
+                        "disables the liveness layer).")
+    g.add_argument("--heartbeat-timeout", type=float, default=None,
+                   dest="heartbeat_timeout",
+                   help="Seconds of heartbeat silence after which the "
+                        "driver declares a worker dead and blacklists its "
+                        "host (HVD_TPU_HEARTBEAT_TIMEOUT).")
 
     p.add_argument("--verbose-log-level", default=None,
                    dest="verbose_log_level")

@@ -234,6 +234,11 @@ class TestFlakyRendezvous:
     def _fast_retries(self, monkeypatch):
         monkeypatch.setenv("HVD_TPU_RETRY_INITIAL_BACKOFF", "0.001")
         monkeypatch.setenv("HVD_TPU_RETRY_MAX_BACKOFF", "0.01")
+        # The 'rendezvous' prefix now also matches the server-side gate
+        # (rendezvous.server, PR 3): with BOTH ends 30%-flaky the per-op
+        # failure rate is ~0.51, so convergence needs a deeper budget than
+        # the default 5 attempts.
+        monkeypatch.setenv("HVD_TPU_RETRY_MAX_ATTEMPTS", "12")
 
     def test_30pct_flaky_kv_store_converges(self):
         from horovod_tpu.runner.rendezvous import KVStoreClient, \

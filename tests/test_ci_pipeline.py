@@ -104,6 +104,21 @@ def test_lint_and_chaos_suites_in_every_service():
     assert os.path.exists(os.path.join(root, "tools", "check_knobs.py"))
 
 
+def test_chaos_coordinator_suite_is_seeded_and_exclusive():
+    """The coordinator-kill + heartbeat-timeout drills run as their own
+    CI suite with a pinned HVD_TPU_FAULT_SEED (deterministic replay), and
+    the generic chaos suite must not run the same file twice."""
+    by_name = {name: cmd for name, cmd, _t in COMMON_SUITES}
+    assert "chaos-coordinator" in by_name
+    cmd = by_name["chaos-coordinator"]
+    assert "HVD_TPU_FAULT_SEED=" in cmd
+    assert "tests/test_coordinator_recovery.py" in cmd
+    assert "--ignore=tests/test_coordinator_recovery.py" in by_name["chaos"]
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    assert os.path.exists(
+        os.path.join(root, "tests", "test_coordinator_recovery.py"))
+
+
 def test_check_knobs_lint_is_clean():
     """The knob lint must pass on the tree as committed: every HVD_TPU_*
     env var read in the package is registered in config.py and documented

@@ -1,0 +1,673 @@
+"""Coordinator-crash survivability suite (ISSUE 3).
+
+Covers the durable rendezvous journal + snapshot, the coordinator epoch
+contract, the seeded ``rendezvous.server:crash`` hot-restart drill, the
+heartbeat liveness layer, and the driver re-seed path — plus the
+satellite hardening of ``KVStoreServer.stop()``/``port`` and
+``KVStoreClient.wait()``.
+
+Fast, in-process tests run everywhere; the end-to-end drills (real
+``horovodrun-tpu`` launches) are ``integration``+``slow`` and belong to
+the ``chaos-coordinator`` CI job (ci/gen_pipeline.py), which pins
+``HVD_TPU_FAULT_SEED`` so every run replays the same fault schedule.
+"""
+
+import os
+import pickle
+import socket
+import threading
+import time
+
+import pytest
+
+from horovod_tpu import faults as F
+from horovod_tpu import metrics as M
+from horovod_tpu.runner.rendezvous import (EPOCH_HEADER, KVStoreClient,
+                                           KVStoreServer, RendezvousServer)
+
+pytestmark = pytest.mark.chaos
+
+SEED = 1234
+
+
+@pytest.fixture(autouse=True)
+def _reset_faults():
+    yield
+    F.configure("", seed=0)
+
+
+@pytest.fixture(autouse=True)
+def _fast_retries(monkeypatch):
+    """Crash gaps in these tests are ~0.3s; keep the client's budget wide
+    enough to span them but each backoff tiny."""
+    monkeypatch.setenv("HVD_TPU_RETRY_INITIAL_BACKOFF", "0.02")
+    monkeypatch.setenv("HVD_TPU_RETRY_MAX_BACKOFF", "0.2")
+    monkeypatch.setenv("HVD_TPU_RETRY_MAX_ATTEMPTS", "20")
+
+
+# ---------------------------------------------------------------------------
+# journal + snapshot + epoch
+# ---------------------------------------------------------------------------
+
+class TestJournal:
+    def test_restart_replays_puts_and_deletes(self, tmp_path):
+        d = str(tmp_path)
+        srv = KVStoreServer(journal_dir=d)
+        srv.start()
+        port = srv.port
+        cli = KVStoreClient("127.0.0.1", port, timeout=5)
+        for i in range(8):
+            cli.put("s", f"k{i}", str(i).encode())
+        cli.delete("s", "k0")
+        assert srv.epoch == 1
+        srv.stop()
+
+        before = M.snapshot().get("hvd_tpu_journal_replay_entries_total", 0)
+        srv2 = KVStoreServer(port=port, journal_dir=d)
+        srv2.start()
+        try:
+            assert srv2.epoch == 2          # monotonic across restarts
+            assert srv2.replayed_entries > 0
+            assert srv2.get("s", "k5") == b"5"
+            assert srv2.get("s", "k0") is None      # delete replayed
+            snap = M.snapshot()
+            assert snap["hvd_tpu_journal_replay_entries_total"] > before
+            assert snap["hvd_tpu_coordinator_epoch"] == 2
+        finally:
+            srv2.stop()
+
+    def test_snapshot_compaction_truncates_journal(self, tmp_path):
+        d = str(tmp_path)
+        srv = KVStoreServer(journal_dir=d, snapshot_every=5)
+        srv.start()
+        try:
+            for i in range(12):
+                srv.put("s", f"k{i}", b"v")
+        finally:
+            srv.stop()
+        assert os.path.exists(os.path.join(d, "snapshot.json"))
+        # 12 appends with compaction every 5 leaves only the tail journaled
+        with open(os.path.join(d, "journal.log")) as f:
+            assert len(f.read().splitlines()) < 5
+        srv2 = KVStoreServer(journal_dir=d)
+        srv2.start()
+        try:
+            for i in range(12):
+                assert srv2.get("s", f"k{i}") == b"v"
+        finally:
+            srv2.stop()
+
+    def test_torn_final_record_is_dropped_not_fatal(self, tmp_path):
+        d = str(tmp_path)
+        srv = KVStoreServer(journal_dir=d)
+        srv.start()
+        srv.put("s", "good", b"1")
+        srv.stop()
+        with open(os.path.join(d, "journal.log"), "a") as f:
+            f.write('{"op": "put", "scope": "s", "k')   # torn mid-crash
+        srv2 = KVStoreServer(journal_dir=d)
+        srv2.start()
+        try:
+            assert srv2.get("s", "good") == b"1"
+        finally:
+            srv2.stop()
+
+    def test_ephemeral_scopes_not_journaled(self, tmp_path):
+        d = str(tmp_path)
+        srv = KVStoreServer(journal_dir=d)
+        srv.ephemeral_scopes.add("heartbeat")
+        srv.start()
+        srv.put("heartbeat", "h:0", b"0")
+        srv.put("s", "k", b"v")
+        srv.stop()
+        srv2 = KVStoreServer(journal_dir=d)
+        srv2.ephemeral_scopes.add("heartbeat")
+        srv2.start()
+        try:
+            assert srv2.get("s", "k") == b"v"
+            assert srv2.get("heartbeat", "h:0") is None   # liveness died
+        finally:
+            srv2.stop()
+
+    def test_no_journal_dir_stays_memory_only(self, tmp_path, monkeypatch):
+        monkeypatch.delenv("HVD_TPU_RENDEZVOUS_DIR", raising=False)
+        srv = KVStoreServer()
+        srv.start()
+        srv.put("s", "k", b"v")
+        port = srv.port
+        srv.stop()
+        srv2 = KVStoreServer(port=port)
+        srv2.start()
+        try:
+            assert srv2.get("s", "k") is None
+        finally:
+            srv2.stop()
+
+
+class TestPortPersistence:
+    def test_restarted_launcher_rebinds_persisted_port(self, tmp_path):
+        """Workers freeze the coordinator's addr:port at spawn; a fully
+        restarted launcher (fresh object, port=0) against the same journal
+        dir must come back on the SAME port."""
+        d = str(tmp_path)
+        srv = KVStoreServer(journal_dir=d)
+        srv.start()
+        port = srv.port
+        srv.put("s", "k", b"v")
+        srv.stop()
+        srv2 = KVStoreServer(journal_dir=d)       # note: port=0 requested
+        srv2.start()
+        try:
+            assert srv2.port == port
+            assert srv2.get("s", "k") == b"v"
+        finally:
+            srv2.stop()
+
+
+class TestEpoch:
+    def test_every_response_carries_the_epoch_header(self, tmp_path):
+        from urllib.error import HTTPError
+        from urllib.request import urlopen
+        srv = KVStoreServer(journal_dir=str(tmp_path))
+        srv.start()
+        try:
+            srv.put("s", "k", b"v")
+            with urlopen(f"http://127.0.0.1:{srv.port}/s/k",
+                         timeout=5) as resp:
+                assert resp.headers[EPOCH_HEADER] == "1"
+            with pytest.raises(HTTPError) as ei:
+                urlopen(f"http://127.0.0.1:{srv.port}/s/missing", timeout=5)
+            assert ei.value.headers[EPOCH_HEADER] == "1"   # 404s too
+        finally:
+            srv.stop()
+
+    def test_client_fires_bump_callback_once_per_bump(self, tmp_path):
+        d = str(tmp_path)
+        srv = KVStoreServer(journal_dir=d)
+        srv.start()
+        port = srv.port
+        srv.put("s", "k", b"v")
+        bumps = []
+        cli = KVStoreClient("127.0.0.1", port, timeout=5,
+                            on_epoch_bump=lambda o, n: bumps.append((o, n)))
+        assert cli.get("s", "k") == b"v"
+        assert bumps == []           # first contact establishes a baseline
+        srv.stop()
+        srv2 = KVStoreServer(port=port, journal_dir=d)
+        srv2.start()
+        try:
+            assert cli.get("s", "k") == b"v"
+            assert cli.get("s", "k") == b"v"
+            assert bumps == [(1, 2)]     # exactly one callback per bump
+            assert cli.epoch_seen == 2
+        finally:
+            srv2.stop()
+
+    def test_failed_bump_callback_is_retried_on_next_response(self,
+                                                              tmp_path):
+        """A re-registration that fails (sick just-restarted coordinator)
+        must re-fire on a later response, not be silently final."""
+        d = str(tmp_path)
+        srv = KVStoreServer(journal_dir=d)
+        srv.start()
+        port = srv.port
+        srv.put("s", "k", b"v")
+        calls = []
+
+        def flaky_cb(old, new):
+            calls.append((old, new))
+            if len(calls) == 1:
+                raise ConnectionError("store still sick")
+
+        cli = KVStoreClient("127.0.0.1", port, timeout=5,
+                            on_epoch_bump=flaky_cb)
+        assert cli.get("s", "k") == b"v"
+        srv.stop()
+        srv2 = KVStoreServer(port=port, journal_dir=d)
+        srv2.start()
+        try:
+            cli.get("s", "k")            # bump observed; callback fails
+            cli.get("s", "k")            # retried and succeeds
+            cli.get("s", "k")            # settled: no third call
+            assert calls == [(1, 2), (1, 2)]
+            assert cli.epoch_seen == 2
+        finally:
+            srv2.stop()
+
+
+# ---------------------------------------------------------------------------
+# seeded coordinator-crash drill (in-process)
+# ---------------------------------------------------------------------------
+
+class TestCoordinatorCrashDrill:
+    def test_crash_once_hot_restarts_from_journal(self, tmp_path):
+        d = str(tmp_path)
+        srv = KVStoreServer(journal_dir=d)
+        srv.start()
+        port = srv.port
+        try:
+            cli = KVStoreClient("127.0.0.1", port, timeout=5)
+            for i in range(5):
+                cli.put("s", f"k{i}", str(i).encode())
+            bumps = []
+            cli.on_epoch_bump = lambda o, n: bumps.append((o, n))
+            F.configure("rendezvous.server:crash:once", seed=SEED)
+            # the very next op hits the injected crash; the client's retry
+            # policy spans the supervisor's hot-restart window
+            assert cli.get("s", "k3") == b"3"
+            assert srv.epoch == 2
+            assert srv.replayed_entries >= 5
+            assert srv.port == port        # SAME port workers already know
+            assert bumps == [(1, 2)]
+            # 'once' consumed: the store keeps serving
+            cli.put("s", "after", b"crash")
+            assert cli.get("s", "after") == b"crash"
+            snap = M.snapshot()
+            assert snap[
+                'hvd_tpu_faults_injected_total{site="rendezvous.server",'
+                'kind="crash"}'] >= 1
+        finally:
+            F.configure("", seed=0)
+            srv.stop()
+
+    def test_crash_drill_is_deterministic(self, tmp_path):
+        """Same seed, same spec, same op sequence -> the crash lands on
+        the same request both times."""
+        hits = []
+        for run in range(2):
+            d = str(tmp_path / f"run{run}")
+            F.configure("rendezvous.server:crash:once:after=3", seed=SEED)
+            srv = KVStoreServer(journal_dir=d)
+            srv.start()
+            try:
+                cli = KVStoreClient("127.0.0.1", srv.port, timeout=5)
+                epochs = []
+                for i in range(6):
+                    cli.put("s", f"k{i}", b"v")
+                    epochs.append(srv.epoch)
+                hits.append(epochs)
+            finally:
+                F.configure("", seed=0)
+                srv.stop()
+        assert hits[0] == hits[1]
+        assert hits[0][-1] == 2           # the crash fired in both runs
+
+    def test_server_error_fault_is_a_retried_503(self, tmp_path):
+        F.configure("rendezvous.server:error:times=2", seed=SEED)
+        srv = KVStoreServer()
+        srv.start()
+        try:
+            cli = KVStoreClient("127.0.0.1", srv.port, timeout=5)
+            cli.put("s", "k", b"v")          # absorbs the injected 503s
+            assert cli.get("s", "k") == b"v"
+        finally:
+            F.configure("", seed=0)
+            srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# satellites: stop()/port + wait() deadline cap
+# ---------------------------------------------------------------------------
+
+class TestServerLifecycleSatellites:
+    def test_port_returns_last_bound_after_stop(self):
+        srv = KVStoreServer()
+        bound = srv.start()
+        srv.stop()
+        assert srv.port == bound           # used by the hot-restart rebind
+
+    def test_port_before_start_still_raises(self):
+        with pytest.raises(RuntimeError):
+            KVStoreServer().port
+
+    def test_stop_start_cycle_does_not_trip_the_supervisor(self, tmp_path):
+        """stop() wakes the supervisor via the crash flag; a later start()
+        must clear it, or the supervisor would misread the old wakeup as a
+        crash and fight the fresh server for its port."""
+        srv = KVStoreServer(journal_dir=str(tmp_path))
+        srv.start()
+        srv.put("s", "k", b"v")
+        srv.stop()
+        srv.start()
+        try:
+            time.sleep(0.6)       # a misfiring supervisor acts within 0.2s
+            assert srv.epoch == 2         # not re-bumped behind our back
+            assert srv.get("s", "k") == b"v"
+        finally:
+            srv.stop()
+
+    def test_stop_is_idempotent_under_concurrent_callers(self):
+        srv = KVStoreServer()
+        srv.start()
+        errors = []
+
+        def stopper():
+            try:
+                srv.stop()
+            except Exception as e:   # noqa: BLE001 — the test's assertion
+                errors.append(e)
+
+        threads = [threading.Thread(target=stopper) for _ in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=10)
+        assert not errors
+        srv.stop()                         # and again, sequentially
+
+
+class TestWaitDeadline:
+    def test_wait_bounded_by_its_deadline_against_a_hung_server(self):
+        """A coordinator that accepts but never answers must not stretch
+        wait(timeout=T) to http_timeout x retries: each inner get's HTTP
+        timeout and retry budget are capped by the remaining deadline."""
+        stalled = socket.socket()
+        stalled.bind(("127.0.0.1", 0))
+        stalled.listen(8)
+        try:
+            port = stalled.getsockname()[1]
+            # a 30s per-request timeout against a 1.5s wait deadline
+            cli = KVStoreClient("127.0.0.1", port, timeout=30.0)
+            start = time.monotonic()
+            with pytest.raises(TimeoutError):
+                cli.wait("s", "k", timeout=1.5, poll_interval=0.05)
+            elapsed = time.monotonic() - start
+            assert elapsed < 6.0, elapsed
+            assert elapsed >= 1.4, elapsed   # and it did wait its own budget
+        finally:
+            stalled.close()
+
+    def test_wait_still_returns_value_from_live_server(self):
+        srv = KVStoreServer()
+        srv.start()
+        try:
+            srv.put("s", "late", b"v")
+            cli = KVStoreClient("127.0.0.1", srv.port, timeout=5)
+            assert cli.wait("s", "late", timeout=5) == b"v"
+        finally:
+            srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# heartbeat liveness
+# ---------------------------------------------------------------------------
+
+class TestHeartbeatMonitor:
+    def test_declares_only_armed_and_silent_slots(self):
+        from horovod_tpu.elastic.heartbeat import HeartbeatMonitor
+        dead = []
+        mon = HeartbeatMonitor(
+            on_dead=lambda h, s, r: dead.append((h, s, r)),
+            timeout=0.2, poll_interval=0.05)
+        # never-armed slot: no beat ever arrived -> never declared
+        mon.check_now()
+        assert dead == []
+        mon.observe("hostA:0", b"0")
+        mon.observe("hostB:0", b"1")
+        time.sleep(0.3)
+        mon.observe("hostA:0", b"0")       # A keeps beating, B went silent
+        before = M.snapshot().get(
+            'hvd_tpu_heartbeat_misses_total{rank="1"}', 0)
+        mon.check_now()
+        assert dead == [("hostB", 0, "1")]
+        assert M.snapshot()[
+            'hvd_tpu_heartbeat_misses_total{rank="1"}'] == before + 1
+        mon.check_now()                    # declared once, not repeatedly
+        assert len(dead) == 1
+
+    def test_forget_and_reset_clear_tracking(self):
+        from horovod_tpu.elastic.heartbeat import HeartbeatMonitor
+        dead = []
+        mon = HeartbeatMonitor(on_dead=lambda *a: dead.append(a),
+                               timeout=0.05, poll_interval=0.05)
+        mon.observe("hostA:0", b"0")
+        mon.forget("hostA", 0)             # worker exited: silence expected
+        mon.observe("hostB:0", b"1")
+        mon.reset()                        # new generation
+        time.sleep(0.1)
+        mon.check_now()
+        assert dead == []
+
+    def test_sender_miss_fault_suppresses_beats(self):
+        from horovod_tpu.elastic.heartbeat import HeartbeatSender
+        srv = KVStoreServer()
+        srv.start()
+        try:
+            cli = KVStoreClient("127.0.0.1", srv.port, timeout=5)
+            sender = HeartbeatSender(cli, "hostX", 0, rank=3, interval=60)
+            assert sender.beat_once()
+            assert srv.get("heartbeat", "hostX:0") == b"3"
+            F.configure("heartbeat.miss:error", seed=SEED)
+            assert not sender.beat_once()  # wedged-worker simulation
+        finally:
+            F.configure("", seed=0)
+            srv.stop()
+
+
+class TestHeartbeatDriverFlow:
+    def test_silent_worker_blacklisted_within_two_timeouts(self, monkeypatch):
+        """The liveness acceptance drill, in-process: a worker whose beats
+        stop is killed via its host event, its FAILURE drives the normal
+        cascade -> blacklist -> respawn flow, and the kill lands in under
+        2 x HVD_TPU_HEARTBEAT_TIMEOUT after the silence began."""
+        from horovod_tpu.elastic.discovery import FixedHosts
+        from horovod_tpu.elastic.driver import ElasticDriver
+        from horovod_tpu.elastic.rendezvous import attach_elastic_handlers
+
+        timeout_s = 1.0
+        monkeypatch.setenv("HVD_TPU_HEARTBEAT_INTERVAL", "0.1")
+        monkeypatch.setenv("HVD_TPU_HEARTBEAT_TIMEOUT", str(timeout_s))
+
+        rdv = RendezvousServer()
+        rdv.start()
+        driver = ElasticDriver(rdv, FixedHosts({"hostA": 1, "hostB": 1}),
+                               min_np=1, max_np=2, timeout=30)
+        attach_elastic_handlers(rdv, driver)
+
+        killed = {}
+        done = threading.Event()
+
+        def create_worker(slot_info, events):
+            host = slot_info.hostname
+            if driver._host_manager.is_blacklisted("hostB"):
+                done.set()                  # respawned generation: succeed
+                return (0, time.time())
+            deadline = time.monotonic() + 20
+            while time.monotonic() < deadline:
+                if any(e.is_set() for e in events):
+                    killed[host] = time.monotonic()
+                    return (1, time.time())
+                if host == "hostA" and "hostB" in killed:
+                    # peer-death cascade (what the JAX coordination
+                    # service does to survivors on real hardware)
+                    time.sleep(0.2)
+                    return (1, time.time())
+                time.sleep(0.02)
+            return (1, time.time())
+
+        start_thread = threading.Thread(
+            target=lambda: driver.start(2, create_worker), daemon=True)
+        start_thread.start()
+
+        # beat both hosts until the generation is up, then silence hostB
+        stop_b = threading.Event()
+
+        def beats():
+            while not done.is_set():
+                driver.record_heartbeat("hostA:0", b"0")
+                if not stop_b.is_set():
+                    driver.record_heartbeat("hostB:0", b"1")
+                time.sleep(0.05)
+
+        beat_thread = threading.Thread(target=beats, daemon=True)
+        beat_thread.start()
+        try:
+            deadline = time.monotonic() + 15
+            while driver.world_size() != 2 and time.monotonic() < deadline:
+                time.sleep(0.05)
+            assert driver.world_size() == 2
+            silenced_at = time.monotonic()
+            stop_b.set()
+            assert done.wait(timeout=20), "job never recovered"
+            assert driver._host_manager.is_blacklisted("hostB")
+            assert not driver._host_manager.is_blacklisted("hostA")
+            # blacklist persisted to the (journal-able) rendezvous scope
+            assert "hostB" in rdv.items("blacklist")
+            # detection bound: silence -> kill in < 2x timeout (+ sched
+            # slack for a loaded CI box)
+            assert killed["hostB"] - silenced_at < 2 * timeout_s + 0.5, \
+                killed["hostB"] - silenced_at
+        finally:
+            done.set()
+            driver.stop()
+            start_thread.join(timeout=10)
+            beat_thread.join(timeout=5)
+
+
+# ---------------------------------------------------------------------------
+# driver re-seed from a restored store
+# ---------------------------------------------------------------------------
+
+class TestDriverReseed:
+    def test_restore_from_rendezvous_reseeds_blacklist_and_workers(
+            self, tmp_path, monkeypatch):
+        from horovod_tpu.elastic.discovery import FixedHosts
+        from horovod_tpu.elastic.driver import ElasticDriver
+        from horovod_tpu.elastic.rendezvous import attach_elastic_handlers
+
+        monkeypatch.setenv("HVD_TPU_HEARTBEAT_INTERVAL", "0")
+        d = str(tmp_path)
+        srv = RendezvousServer(journal_dir=d)
+        srv.start()
+        port = srv.port
+        # what a previous coordinator incarnation learned
+        srv.put("blacklist", "badhost", b"1")
+        srv.put("worker_addresses", "hostA:0",
+                pickle.dumps(({"lo": [("127.0.0.1", 45678)]}, b"secret")))
+        srv.stop()
+
+        srv2 = RendezvousServer(port=port, journal_dir=d)
+        srv2.start()
+        assert srv2.replayed_entries >= 2
+        driver = ElasticDriver(srv2, FixedHosts({"hostA": 1}),
+                               min_np=1, timeout=5)
+        try:
+            attach_elastic_handlers(srv2, driver)
+            assert driver.restore_from_rendezvous() == 2
+            assert driver._host_manager.is_blacklisted("badhost")
+            assert ("hostA", 0) in driver._worker_clients
+        finally:
+            driver.stop()
+
+    def test_worker_re_registers_after_coordinator_restart(self, tmp_path):
+        """The full worker-side loop: registration, beats, a simulated
+        coordinator crash, and an automatic re-registration when the next
+        beat observes the epoch bump."""
+        from horovod_tpu.elastic.worker import WorkerNotificationManager
+
+        d = str(tmp_path)
+        srv = KVStoreServer(journal_dir=d)
+        srv.ephemeral_scopes.add("heartbeat")
+        srv.start()
+        registrations = []
+        srv.add_put_handler("worker_addresses",
+                            lambda k, v: registrations.append(k))
+        os.environ["HVD_TPU_HEARTBEAT_INTERVAL"] = "0.1"
+        manager = WorkerNotificationManager()
+        try:
+            manager.init(rendezvous_addr="127.0.0.1",
+                         rendezvous_port=srv.port,
+                         hostname="hostW", local_rank=0)
+            assert registrations == ["hostW:0"]
+            F.configure("rendezvous.server:crash:once", seed=SEED)
+            deadline = time.monotonic() + 15
+            while len(registrations) < 2 and time.monotonic() < deadline:
+                time.sleep(0.05)
+            assert registrations.count("hostW:0") >= 2, registrations
+            assert srv.epoch == 2
+        finally:
+            os.environ.pop("HVD_TPU_HEARTBEAT_INTERVAL", None)
+            F.configure("", seed=0)
+            manager.shutdown()
+            srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# end-to-end drills (real launcher) — chaos-coordinator CI job
+# ---------------------------------------------------------------------------
+
+@pytest.mark.integration
+@pytest.mark.slow
+def test_e2e_coordinator_crash_job_survives_and_recovers():
+    """ISSUE 3 acceptance drill 1: under rendezvous.server:crash:once with
+    a seeded run and a journal dir, the launcher hot-restarts the KV store
+    from its journal, workers re-register on the epoch bump, and a
+    subsequent worker kill still recovers from committed elastic state —
+    no manual intervention, exit 0, every epoch trained."""
+    import re
+    import tempfile
+
+    from test_elastic_e2e import _events, _finish, _launch
+
+    with tempfile.TemporaryDirectory() as td:
+        proc, _ = _launch(
+            td, "localhost:1\n127.0.0.1:1",
+            extra_env={
+                "HVD_TPU_FAULT_SPEC": "rendezvous.server:crash:once:after=10",
+                "HVD_TPU_FAULT_SEED": str(SEED),
+                "HVD_TPU_RENDEZVOUS_DIR": os.path.join(td, "rdv"),
+                "HVD_TPU_HEARTBEAT_INTERVAL": "1",
+                "HVD_TPU_RETRY_INITIAL_BACKOFF": "0.05",
+                "ELASTIC_TEST_KILL_RANK": "1",
+                "ELASTIC_TEST_KILL_EPOCH": "2",
+            },
+            np_=2, min_np=1, epochs=4, timeout=360)
+        code, out = _finish(proc, timeout=360)
+        events = _events(td)
+        assert code == 0, f"launcher exited {code}:\n{out[-6000:]}\n" \
+                          f"events: {events}"
+        # the coordinator actually died and came back from its journal
+        assert "injected coordinator crash" in out, out[-6000:]
+        assert "hot-restarted KV store" in out, out[-6000:]
+        # at least one worker noticed the epoch bump and re-registered
+        assert "re-registering this worker" in out, out[-6000:]
+        # and the ordinary elastic recovery still worked afterwards
+        done = [e for e in events if e.startswith("done ")]
+        assert done, events
+        m = re.search(r"done rank=0 size=(\d+) epochs=(\d+)", done[0])
+        assert m and int(m.group(1)) == 1 and int(m.group(2)) == 4, events
+
+
+@pytest.mark.integration
+@pytest.mark.slow
+def test_e2e_heartbeat_timeout_blacklists_silent_worker():
+    """ISSUE 3 acceptance drill 2: a worker whose heartbeats are
+    suppressed (simulating a silently-wedged host) is declared dead via
+    heartbeat timeout and blacklisted well before any stall deadline; the
+    survivor finishes every epoch."""
+    import re
+    import tempfile
+
+    from test_elastic_e2e import _events, _finish, _launch
+
+    with tempfile.TemporaryDirectory() as td:
+        proc, _ = _launch(
+            td, "localhost:1\n127.0.0.1:1",
+            extra_env={
+                "HVD_TPU_FAULT_SPEC": "heartbeat.miss:error:after=2:rank=1",
+                "HVD_TPU_FAULT_SEED": str(SEED),
+                "HVD_TPU_HEARTBEAT_INTERVAL": "1",
+                "HVD_TPU_HEARTBEAT_TIMEOUT": "6",
+                "ELASTIC_TEST_EPOCH_SLEEP": "2.0",
+            },
+            np_=2, min_np=1, epochs=6, timeout=360)
+        code, out = _finish(proc, timeout=360)
+        events = _events(td)
+        assert code == 0, f"launcher exited {code}:\n{out[-6000:]}\n" \
+                          f"events: {events}"
+        # the monitor (not a stall deadline, not a worker exit) detected it
+        assert "declaring it dead" in out, out[-6000:]
+        done = [e for e in events if e.startswith("done ")]
+        assert done, events
+        m = re.search(r"done rank=0 size=(\d+) epochs=(\d+)", done[0])
+        assert m and int(m.group(1)) == 1 and int(m.group(2)) == 6, events

@@ -115,6 +115,54 @@ class TestCheckpoint:
         os.makedirs(tmp_path / "step_0000000009.orbax-checkpoint-tmp-12345")
         assert ckpt.latest_step(str(tmp_path)) == 7
 
+    def test_restore_fallback_walks_back_past_truncated_step(
+            self, tmp_path, hvd_world):
+        """A crash can complete the orbax rename but not the contents: the
+        latest step dir exists yet cannot be restored. fallback=True must
+        walk back to the previous completed step, count the fallback, and
+        keep raising without the opt-in."""
+        from horovod_tpu import metrics as M
+        tree = {"w": jnp.arange(4, dtype=jnp.float32)}
+        ckpt.save(str(tmp_path), 1, tree)
+        # truncated checkpoint: the renamed dir is there, its payload not
+        os.makedirs(tmp_path / "step_0000000002")
+        (tmp_path / "step_0000000002" / "checkpoint").write_bytes(b"\x00trunc")
+        assert ckpt.latest_step(str(tmp_path)) == 2
+        with pytest.raises(Exception):
+            ckpt.restore(str(tmp_path))              # default: surface it
+        before = M.snapshot().get("hvd_tpu_checkpoint_fallbacks_total", 0)
+        out = ckpt.restore(str(tmp_path), fallback=True)
+        np.testing.assert_allclose(np.asarray(out["w"]),
+                                   np.asarray(tree["w"]))
+        assert M.snapshot()["hvd_tpu_checkpoint_fallbacks_total"] == \
+            before + 1
+
+    def test_restore_fallback_no_good_step_raises(self, tmp_path, hvd_world):
+        os.makedirs(tmp_path / "step_0000000003")
+        with pytest.raises(Exception):
+            ckpt.restore(str(tmp_path), fallback=True)
+
+    def test_restore_explicit_step_with_fallback(self, tmp_path, hvd_world):
+        """fallback from an explicit step walks back only to EARLIER
+        steps, never forward."""
+        tree = {"w": jnp.ones(2, jnp.float32)}
+        ckpt.save(str(tmp_path), 0, tree)
+        os.makedirs(tmp_path / "step_0000000004")   # corrupt target
+        out = ckpt.restore(str(tmp_path), step=4, fallback=True)
+        np.testing.assert_allclose(np.asarray(out["w"]), 1.0)
+
+    def test_restore_fallback_counts_missing_requested_step(
+            self, tmp_path, hvd_world):
+        """A requested step that never existed must be a COUNTED fallback,
+        not a silent resume from older weights."""
+        from horovod_tpu import metrics as M
+        ckpt.save(str(tmp_path), 3, {"w": jnp.zeros(2, jnp.float32)})
+        before = M.snapshot().get("hvd_tpu_checkpoint_fallbacks_total", 0)
+        out = ckpt.restore(str(tmp_path), step=99, fallback=True)
+        assert out is not None
+        assert M.snapshot()["hvd_tpu_checkpoint_fallbacks_total"] == \
+            before + 1
+
     def test_checkpoint_callback_resave_same_epoch(self, tmp_path, hvd_world):
         from horovod_tpu import callbacks as cbs
         run = cbs.TrainingRun(params={"w": jnp.zeros(2)})
