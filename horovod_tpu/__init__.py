@@ -80,7 +80,7 @@ def __getattr__(name):
     if name == "Estimator":
         from .estimator import Estimator
         return Estimator
-    if name in ("callbacks", "torch", "data", "checkpoint",
+    if name in ("callbacks", "torch", "data", "checkpoint", "checkpointing",
                 "tensorflow", "keras", "spark"):
         # importlib, not `from . import x`: the fromlist lookup re-enters
         # this __getattr__ before sys.modules is populated (see `elastic`)

@@ -31,6 +31,7 @@ Typical loop::
             # NOTE: always train on run.params (re-read after the hooks):
             # BroadcastGlobalVariablesCallback rewrites it at batch 0
         cbs.on_epoch_end(epoch, logs)
+    cbs.on_train_end(logs)   # drains async checkpoint saves, etc.
 """
 
 from dataclasses import dataclass, field
@@ -53,6 +54,9 @@ class Callback:
     run: TrainingRun = None  # set by CallbackList
 
     def on_train_begin(self, logs=None):
+        pass
+
+    def on_train_end(self, logs=None):
         pass
 
     def on_epoch_begin(self, epoch: int, logs=None):
@@ -84,6 +88,11 @@ class CallbackList:
 
     def on_train_begin(self, logs=None):
         self._fire("on_train_begin", logs)
+
+    def on_train_end(self, logs=None):
+        # fired by the loop after the last epoch; async checkpoint
+        # callbacks drain their in-flight saves here
+        self._fire("on_train_end", logs)
 
     def on_epoch_begin(self, epoch, logs=None):
         self.run.epoch = epoch

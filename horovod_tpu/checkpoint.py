@@ -1,162 +1,38 @@
-"""Checkpoint / resume.
+"""Checkpoint / resume — compatibility facade.
 
-The reference has no checkpoint subsystem of its own (SURVEY.md §5): its
-pattern is (a) rank-0-only framework checkpoints in examples
-(/root/reference/examples/pytorch_mnist.py), (b) elastic in-memory State
-commit/restore (common/elastic.py:60-101), (c) broadcast_parameters /
-broadcast_object to seed restarted workers. The TPU build provides a real
-one, because on TPU pods checkpointing is a first-class scaling concern:
+.. deprecated::
+    This module is now a thin facade over the
+    :mod:`horovod_tpu.checkpointing` package, which is the real
+    subsystem: async snapshot-then-persist saves, per-process sharded
+    writes with integrity manifests and an atomic ``COMMIT`` protocol,
+    elastic resharding restore, and retention GC. New code should use
+    :class:`horovod_tpu.checkpointing.CheckpointManager` directly; the
+    functions here keep the original synchronous, one-shot signatures so
+    existing scripts and examples run unchanged.
 
-* :func:`save` / :func:`restore` — orbax-backed pytree checkpointing.
-  Process 0 coordinates in the single-controller model (the reference's
-  rank-0-only convention); with a multi-host jax runtime orbax writes
-  sharded arrays from every host.
-* :func:`latest_step` — resume discovery.
-* :class:`CheckpointCallback` — periodic saves from the callback loop.
+Facade contracts preserved from the old module:
 
-Restored arrays can be re-staged onto a target sharding (mesh topology may
-differ across restarts — the elastic resume case).
+* :func:`save` returns only after the step is fully committed (and, in
+  eager multi-process runs, after a barrier — non-root ranks can't race
+  past an unfinished rank-0 write);
+* :func:`restore` defaults to the latest completed step; ``fallback=True``
+  walks back past corrupt/partial steps, counting
+  ``hvd_tpu_checkpoint_fallbacks_total``;
+* :func:`latest_step` never reports a crashed save (commit-marker gating
+  for new-format steps, orbax's rename protocol for legacy ones);
+* :class:`CheckpointCallback` saves every N epochs from the callback loop.
+
+Checkpoints written by the old orbax-backed module restore transparently
+(the package detects legacy step dirs and routes them through orbax).
 """
 
-import logging
-import os
-import re
-from typing import Any, Optional
-
-from . import metrics as _metrics
-from .callbacks import Callback
-
-log = logging.getLogger("horovod_tpu.checkpoint")
-
-_M_FALLBACKS = _metrics.counter(
-    "hvd_tpu_checkpoint_fallbacks_total",
-    "restore(fallback=True) calls that skipped a corrupt/partial latest "
-    "checkpoint and restored an earlier completed step instead.")
-
-# completed checkpoints only: orbax writes to
-# "step_<n>.orbax-checkpoint-tmp-<ts>" before renaming, and a crashed save
-# must not poison discovery
-_STEP_RE = re.compile(r"^step_(\d+)$")
-
-
-def _checkpointer():
-    import orbax.checkpoint as ocp
-    return ocp.PyTreeCheckpointer()
-
-
-def _step_dir(directory: str, step: int) -> str:
-    return os.path.join(directory, f"step_{step:010d}")
-
-
-def save(directory: str, step: int, tree: Any, force: bool = False) -> str:
-    """Save ``tree`` (params / train state pytree) for ``step``. Only
-    process 0 writes in the one-process-per-host eager model unless the
-    jax runtime is multi-host-initialized (then orbax coordinates all
-    hosts). Returns the checkpoint path."""
-    from . import basics
-    path = _step_dir(directory, step)
-    multihost = False
-    try:
-        import jax
-        multihost = jax.process_count() > 1
-    except Exception:
-        pass
-    if multihost or not basics.is_initialized() or basics.rank() == 0:
-        _checkpointer().save(path, tree, force=force)
-    if not multihost and basics.is_initialized() and basics.size() > 1:
-        # non-root processes must not observe the path before rank 0's
-        # write completes (reference convention: rank-0 checkpoint + implicit
-        # barrier before the next collective)
-        from .collectives import barrier
-        barrier()
-    return path
-
-
-def restore(directory: str, step: Optional[int] = None, target: Any = None,
-            sharding=None, fallback: bool = False) -> Any:
-    """Restore the pytree saved at ``step`` (default: latest). ``target``
-    (optional) provides structure/dtypes; ``sharding`` re-stages leaves
-    onto a mesh after restore (elastic resume onto a resized mesh).
-
-    ``fallback=True`` (opt-in): when the selected step is corrupt or
-    partial — a crash can rename an orbax dir and die before the contents
-    are complete — walk back to the previous completed step instead of
-    raising, logging each skip and counting
-    ``hvd_tpu_checkpoint_fallbacks_total``. Only the *final* candidate's
-    error propagates; a job with one good checkpoint always resumes.
-    """
-    if step is None:
-        candidates = _steps(directory)
-        if not candidates:
-            raise FileNotFoundError(
-                f"no checkpoints under {directory!r}")
-    elif fallback:
-        candidates = [s for s in _steps(directory) if s <= step]
-        if not candidates:
-            raise FileNotFoundError(
-                f"no checkpoints at or before step {step} under "
-                f"{directory!r}")
-    else:
-        candidates = [step]
-    if not fallback:
-        candidates = candidates[:1]
-    # A requested step that does not exist at all is itself a fallback:
-    # resuming from older weights must never be silent.
-    fell_back = step is not None and fallback and candidates[0] != step
-    if fell_back:
-        log.warning(
-            "checkpoint: step %d does not exist under %s; falling back to "
-            "step %d", step, directory, candidates[0])
-    for i, cand in enumerate(candidates):
-        try:
-            tree = _checkpointer().restore(_step_dir(directory, cand),
-                                           item=target)
-        except Exception as e:  # noqa: BLE001 — orbax raises various types
-            if i + 1 >= len(candidates):
-                raise
-            log.warning(
-                "checkpoint: step %d under %s is corrupt or partial (%s); "
-                "falling back to step %d", cand, directory, e,
-                candidates[i + 1])
-            fell_back = True
-            continue
-        if fell_back:
-            _M_FALLBACKS.inc()
-        if sharding is not None:
-            import jax
-            tree = jax.device_put(tree, sharding)
-        return tree
+from .checkpointing import (CheckpointCallback, CheckpointManager,  # noqa: F401
+                            IntegrityError, latest_step, restore, save)
+from .checkpointing.layout import completed_steps as _completed_steps
+from .checkpointing.manager import _M_FALLBACKS  # noqa: F401  (compat)
 
 
 def _steps(directory: str):
-    """Completed step numbers under ``directory``, newest first (the one
-    scan restore's fallback walk and latest_step both derive from)."""
-    try:
-        names = os.listdir(directory)
-    except FileNotFoundError:
-        return []
-    return sorted((int(m.group(1)) for name in names
-                   if (m := _STEP_RE.match(name))), reverse=True)
-
-
-def latest_step(directory: str) -> Optional[int]:
-    steps = _steps(directory)
-    return steps[0] if steps else None
-
-
-class CheckpointCallback(Callback):
-    """Save ``run.params`` every ``epochs_per_save`` epochs (rank-0
-    convention of the reference examples: examples/pytorch_mnist.py guards
-    checkpointing with hvd.rank() == 0)."""
-
-    def __init__(self, directory: str, epochs_per_save: int = 1,
-                 force: bool = True):
-        self.directory = directory
-        self.epochs_per_save = epochs_per_save
-        # force=True: an elastic resume re-saves epochs that already exist
-        # on disk; refusing to overwrite would kill the resumed run
-        self.force = force
-
-    def on_epoch_end(self, epoch, logs=None):
-        if (epoch + 1) % self.epochs_per_save == 0:
-            save(self.directory, epoch, self.run.params, force=self.force)
+    """Completed step numbers, newest first (kept for callers of the old
+    private helper)."""
+    return _completed_steps(directory)

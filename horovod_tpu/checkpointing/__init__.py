@@ -1,0 +1,45 @@
+"""Async sharded checkpointing subsystem.
+
+The CheckFreq/Orbax-style pattern grown from the old single-writer
+synchronous ``horovod_tpu.checkpoint`` module (which remains as a thin
+facade over this package):
+
+* **snapshot-then-persist** — ``CheckpointManager.save(step, tree)``
+  copies leaves to host on the training thread, a bounded background
+  writer does the serialize/checksum/fsync/commit
+  (:mod:`.manager`, :mod:`.snapshot`);
+* **sharded multi-writer layout with integrity manifests** — each
+  process writes only the shards it owns; a JSON manifest carries
+  per-shard CRC32s and an atomically-renamed ``COMMIT`` marker gates
+  discovery (:mod:`.layout`);
+* **elastic resharding restore** — shards reassemble by global offsets
+  and re-stage onto any target sharding, so the saved and restoring
+  world sizes are independent;
+* **retention GC** — keep-last-N / keep-every-K from the writer thread
+  (:mod:`.gc`).
+
+See docs/checkpoint.md for the full layout, commit protocol, knobs,
+metrics, and chaos-drill recipes.
+"""
+
+from .gc import collect, retained_steps                          # noqa: F401
+from .layout import (COMMITTED, LEGACY, PARTIAL, IntegrityError,  # noqa: F401
+                     classify, completed_steps, latest_step, step_dir)
+from .manager import (CheckpointCallback, CheckpointManager,      # noqa: F401
+                      CheckpointWriterCrashed, drain_all)
+from .snapshot import snapshot_tree                               # noqa: F401
+
+
+def save(directory: str, step: int, tree, force: bool = False) -> str:
+    """One-shot synchronous save (the facade's contract: returns after
+    the step is committed; eager multi-process runs barrier)."""
+    return CheckpointManager(directory).save(step, tree, async_=False,
+                                             force=force)
+
+
+def restore(directory: str, step=None, target=None, sharding=None,
+            fallback: bool = False):
+    """One-shot restore through a throwaway manager (see
+    :meth:`CheckpointManager.restore`)."""
+    return CheckpointManager(directory).restore(
+        step=step, target=target, sharding=sharding, fallback=fallback)

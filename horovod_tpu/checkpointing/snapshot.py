@@ -1,0 +1,209 @@
+"""Host snapshots of pytrees, and their reassembly.
+
+The training-thread half of snapshot-then-persist: :func:`snapshot_tree`
+copies every leaf off the devices (``jax.Array`` -> host numpy, one copy)
+and records, per leaf, the *global* shape plus the shards this process
+owns. It does no file I/O, no checksumming, no serialization of array
+bytes — those are the background writer's job — so the training loop
+pays device-transfer cost only.
+
+Shard ownership follows jax's addressable-shard model: a process owns
+the shards of its local devices whose ``replica_id`` is 0, so replicated
+leaves are written exactly once across the job and an N-way sharded leaf
+is written as N independent files by whoever holds each piece. On a
+single process (the eager path) that degenerates to "rank 0 writes
+everything", matching the reference's rank-0 convention.
+
+Reassembly (:func:`assemble_array`) is the inverse and is deliberately
+world-size-agnostic: it pastes shards into a full host array by their
+recorded offsets, which is what makes restoring a world-size-4
+checkpoint onto 2 processes (or 1) a plain read — resharding happens
+afterwards via ``jax.device_put`` onto the *target* sharding.
+"""
+
+import base64
+import pickle
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from .layout import IntegrityError
+
+#: leaf kinds in the manifest
+ARRAY = "array"
+OBJECT = "object"
+
+
+class HostShard:
+    """One contiguous piece of a leaf this process owns, already on host."""
+
+    __slots__ = ("starts", "data")
+
+    def __init__(self, starts: Tuple[int, ...], data: np.ndarray):
+        self.starts = starts
+        self.data = data
+
+
+class LeafSnapshot:
+    """Host copy of one pytree leaf plus its global layout.
+
+    ``local=True`` marks leaves every process holds in full with no
+    jax-level ownership information (plain numpy arrays, python
+    objects): in a multi-host save only process 0 writes them — N
+    processes renaming possibly-different bytes onto one shard file
+    would race. jax.Array leaves carry real ownership (addressable
+    shards + replica ids) and are written by whoever owns each piece.
+    """
+
+    __slots__ = ("index", "path", "kind", "dtype", "shape", "shards",
+                 "payload", "local")
+
+    def __init__(self, index: int, path: str, kind: str,
+                 dtype: Optional[str] = None,
+                 shape: Optional[Tuple[int, ...]] = None,
+                 shards: Optional[List[HostShard]] = None,
+                 payload: Optional[bytes] = None, local: bool = True):
+        self.index = index
+        self.path = path
+        self.kind = kind
+        self.dtype = dtype
+        self.shape = shape
+        self.shards = shards or []
+        self.payload = payload      # OBJECT leaves: pickled bytes
+        self.local = local
+
+    def nbytes(self) -> int:
+        if self.kind == OBJECT:
+            return len(self.payload or b"")
+        return sum(s.data.nbytes for s in self.shards)
+
+
+class TreeSnapshot:
+    """Everything save() captured on the training thread."""
+
+    __slots__ = ("treedef_blob", "leaves", "world_size")
+
+    def __init__(self, treedef_blob: bytes, leaves: List[LeafSnapshot],
+                 world_size: int):
+        self.treedef_blob = treedef_blob
+        self.leaves = leaves
+        self.world_size = world_size
+
+    def nbytes(self) -> int:
+        return sum(leaf.nbytes() for leaf in self.leaves)
+
+
+def _shard_starts(index, ndim: int) -> Tuple[int, ...]:
+    """Global start offsets from a shard's index (tuple of slices)."""
+    if not index:
+        return ()
+    starts = []
+    for s in index[:ndim]:
+        starts.append(int(s.start) if s.start is not None else 0)
+    return tuple(starts)
+
+
+def _snapshot_array_leaf(index: int, path: str, leaf) -> LeafSnapshot:
+    import jax
+
+    if isinstance(leaf, jax.Array):
+        shards = []
+        for shard in leaf.addressable_shards:
+            if shard.replica_id != 0:
+                continue        # replicated piece owned elsewhere
+            # np.array (owned copy), NOT np.asarray: on the CPU backend
+            # device_get can alias the device buffer, and a donated
+            # buffer overwritten by the next jitted step would corrupt
+            # the snapshot while it waits in the writer queue
+            shards.append(HostShard(
+                _shard_starts(shard.index, leaf.ndim),
+                np.array(jax.device_get(shard.data))))
+        return LeafSnapshot(index, path, ARRAY, dtype=str(leaf.dtype),
+                            shape=tuple(leaf.shape), shards=shards,
+                            local=False)
+    arr = np.array(leaf)    # copy: the caller may mutate after save()
+    return LeafSnapshot(index, path, ARRAY, dtype=str(arr.dtype),
+                        shape=tuple(arr.shape),
+                        shards=[HostShard((0,) * arr.ndim, arr)])
+
+
+def snapshot_tree(tree: Any, world_size: int = 1) -> TreeSnapshot:
+    """Flatten ``tree`` and copy every leaf to host memory (the
+    synchronous, on-thread part of an async save)."""
+    import jax
+
+    flat, treedef = jax.tree_util.tree_flatten_with_path(tree)
+    leaves: List[LeafSnapshot] = []
+    for i, (keypath, leaf) in enumerate(flat):
+        path = jax.tree_util.keystr(keypath)
+        if isinstance(leaf, (jax.Array, np.ndarray, np.generic)):
+            leaves.append(_snapshot_array_leaf(i, path, leaf))
+        else:
+            # non-array leaves (step counters, strings, optax schedule
+            # state) round-trip through pickle with their exact types
+            leaves.append(LeafSnapshot(
+                i, path, OBJECT, payload=pickle.dumps(leaf)))
+    return TreeSnapshot(pickle.dumps(treedef), leaves, world_size)
+
+
+# -- manifest <-> snapshot glue --------------------------------------------
+
+def encode_treedef(blob: bytes) -> str:
+    return base64.b64encode(blob).decode("ascii")
+
+
+def decode_treedef(text: str):
+    return pickle.loads(base64.b64decode(text.encode("ascii")))
+
+
+def _np_dtype(name: str) -> np.dtype:
+    try:
+        return np.dtype(name)
+    except TypeError:
+        # low-precision accelerator dtypes (bfloat16, float8_*) register
+        # through ml_dtypes, which jax always ships
+        import ml_dtypes
+        return np.dtype(getattr(ml_dtypes, name))
+
+
+def assemble_array(leaf_manifest: Dict[str, Any],
+                   read_shard: Callable[[Dict[str, Any]], bytes]
+                   ) -> np.ndarray:
+    """Reassemble one ARRAY leaf from its manifest entry.
+
+    ``read_shard(shard_entry) -> bytes`` is provided by the caller (which
+    owns checksum verification and fault accounting). Raises
+    :class:`IntegrityError` when the pasted shards do not exactly cover
+    the leaf — a manifest that lies about coverage must not yield a
+    silently half-initialized array.
+    """
+    dtype = _np_dtype(leaf_manifest["dtype"])
+    shape = tuple(leaf_manifest["shape"])
+    out = np.empty(shape, dtype=dtype)
+    covered = 0
+    for shard in leaf_manifest["shards"]:
+        data = read_shard(shard)
+        piece = np.frombuffer(data, dtype=dtype)
+        sshape = tuple(shard["shape"])
+        if piece.size != int(np.prod(sshape, dtype=np.int64)):
+            raise IntegrityError(
+                f"shard {shard.get('file')!r} of leaf "
+                f"{leaf_manifest.get('path')!r}: payload holds {piece.size} "
+                f"elements, manifest says shape {sshape}")
+        piece = piece.reshape(sshape)
+        starts = tuple(shard.get("starts") or ())
+        if not shape:               # 0-d leaf
+            out[()] = piece[()] if piece.shape == () else piece.ravel()[0]
+        else:
+            sel = tuple(slice(b, b + n) for b, n in zip(starts, sshape))
+            out[sel] = piece
+        covered += piece.size
+    if covered != out.size:
+        raise IntegrityError(
+            f"leaf {leaf_manifest.get('path')!r}: shards cover {covered} "
+            f"of {out.size} elements")
+    return out
+
+
+def assemble_object(payload: bytes) -> Any:
+    return pickle.loads(payload)

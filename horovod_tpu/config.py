@@ -335,6 +335,28 @@ RETRY_DEADLINE = _register(
     help="Overall per-call retry budget in seconds; a retry that would "
          "overrun it surfaces the last error instead of sleeping.")
 
+# -- Checkpointing (no reference equivalent — the reference delegates to
+#    rank-0 framework checkpoints; checkpointing/ is the TPU-pod-scale
+#    subsystem: async snapshot-then-persist, sharded writes, manifests) ------
+CHECKPOINT_MAX_INFLIGHT = _register(
+    "CHECKPOINT_MAX_INFLIGHT", 2, int,
+    help="Bound on async checkpoint saves snapshotted but not yet "
+         "persisted. A training loop that outruns storage blocks in "
+         "save() once the queue is full (backpressure) instead of "
+         "accumulating unbounded host-RAM copies of the model.")
+CHECKPOINT_KEEP = _register(
+    "CHECKPOINT_KEEP", 0, int,
+    help="Retention GC: keep the last N completed checkpoint steps, "
+         "deleting superseded ones from the background writer after "
+         "each commit. 0 (default) keeps everything. Composes with "
+         "HVD_TPU_CHECKPOINT_KEEP_PERIOD (a step survives if either "
+         "rule wants it); the newest step always survives.")
+CHECKPOINT_KEEP_PERIOD = _register(
+    "CHECKPOINT_KEEP_PERIOD", 0, int,
+    help="Retention GC: steps divisible by this period are kept forever "
+         "(milestone checkpoints for offline eval), regardless of "
+         "HVD_TPU_CHECKPOINT_KEEP. 0 (default) disables the rule.")
+
 # -- Misc -------------------------------------------------------------------
 NUM_STREAMS = _register(
     "NUM_STREAMS", 1, int, alias="HOROVOD_NUM_NCCL_STREAMS",

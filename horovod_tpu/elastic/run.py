@@ -153,21 +153,19 @@ def persist_committed_state(state) -> None:
     saved = getattr(state, "_saved_state", None)
     if saved is None:
         return
-    tmp = f"{path}.tmp.{os.getpid()}"
     try:
         # Remote hosts may not have the launcher-created dir; best-effort
-        # local persistence still covers same-host respawns.
+        # local persistence still covers same-host respawns. The
+        # checkpointing layout helper gives tmp+fsync+rename, so a kill
+        # mid-commit can never leave a torn state file (plain rename
+        # without the fsync could surface an empty file after a host
+        # crash — the exact window durable commits exist to close).
+        from ..checkpointing.layout import atomic_write_bytes
         os.makedirs(os.path.dirname(path), exist_ok=True)
-        with open(tmp, "wb") as f:
-            pickle.dump(saved, f)
-        os.replace(tmp, path)
+        atomic_write_bytes(path, pickle.dumps(saved))
     except Exception:  # noqa: BLE001 — durability is best-effort by contract
         log.warning("elastic: failed to persist committed state to %s",
                     path, exc_info=True)
-        try:
-            os.unlink(tmp)
-        except OSError:
-            pass
 
 
 def maybe_load_persisted_state(state) -> bool:
@@ -220,6 +218,11 @@ def reset(state=None) -> None:
     # but scrape/snapshot readers see the increment between reset start
     # and exec).
     _M_RESTARTS.inc()
+    # Async checkpoint saves must land (or fail visibly) before this
+    # process image goes away: a re-exec with a snapshot still queued
+    # would silently drop the newest checkpoint.
+    from ..checkpointing import drain_all
+    drain_all()
     basics.shutdown()
     if not requery_assignment():
         log.info("elastic: this worker has no assignment in the new "

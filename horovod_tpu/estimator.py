@@ -151,6 +151,7 @@ class Estimator:
             if verbose and hvd.rank() == 0:
                 print(f"epoch {epoch}: " + " ".join(
                     f"{k}={v:.4f}" for k, v in logs.items()))
+        cl.on_train_end(logs if epochs > 0 else None)  # drains async saves
         self.params = run.params
         return history
 

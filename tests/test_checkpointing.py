@@ -1,0 +1,490 @@
+"""Async sharded checkpointing subsystem (ISSUE 4).
+
+Acceptance matrix: sync/async round-trips are bit-exact; an async save's
+on-thread portion (snapshot only) is measurably cheaper than the
+synchronous save of the same tree; a checkpoint saved sharded 4-ways
+restores onto 2-way and 1-way shardings; crash-before-COMMIT leaves
+``latest_step`` on the previous committed step and the skip is accounted
+(`hvd_tpu_checkpoint_fallbacks_total` /
+`hvd_tpu_checkpoint_integrity_failures_total`); checksum corruption is
+detected and walked past; GC keeps exactly the policy set; and the
+seeded ``checkpoint.write:crash:once`` drill is deterministic.
+
+This file is owned exclusively by the ``checkpoint`` CI suite (pinned
+HVD_TPU_FAULT_SEED); the generic unit/chaos suites ignore it.
+"""
+
+import json
+import os
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from horovod_tpu import checkpoint as facade
+from horovod_tpu import checkpointing as cp
+from horovod_tpu import faults as F
+from horovod_tpu import metrics as M
+from horovod_tpu.checkpointing import gc as cgc
+from horovod_tpu.checkpointing import layout
+
+SEED = 1234
+
+
+@pytest.fixture(autouse=True)
+def _reset_faults():
+    """Every test leaves the process-wide fault registry disabled."""
+    yield
+    F.configure("", seed=0)
+
+
+def _counter(name):
+    return float(M.snapshot().get(name, 0.0))
+
+
+def _mesh(n):
+    return Mesh(np.array(jax.devices()[:n]), ("world",))
+
+
+def _small_tree():
+    return {"w": jnp.arange(24, dtype=jnp.float32).reshape(4, 6),
+            "b": jnp.arange(3, dtype=jnp.float64) / 7.0,
+            "nested": {"step": 7, "name": "run-a", "flag": True,
+                       "scalar": jnp.float32(2.5)},
+            "empty": np.zeros((0, 4), np.int32)}
+
+
+def _assert_trees_equal(out, ref):
+    ref_flat, ref_def = jax.tree_util.tree_flatten(ref)
+    out_flat, out_def = jax.tree_util.tree_flatten(out)
+    assert out_def == ref_def
+    for o, r in zip(out_flat, ref_flat):
+        if isinstance(r, (jax.Array, np.ndarray, np.generic)):
+            r = np.asarray(r)
+            o = np.asarray(o)
+            assert o.dtype == r.dtype
+            np.testing.assert_array_equal(o, r)   # bit-exact
+        else:
+            assert type(o) is type(r) and o == r
+
+
+# ---------------------------------------------------------------------------
+# round-trip + commit protocol
+# ---------------------------------------------------------------------------
+
+class TestRoundTrip:
+    def test_sync_roundtrip_bit_exact(self, tmp_path):
+        tree = _small_tree()
+        mgr = cp.CheckpointManager(str(tmp_path))
+        path = mgr.save(3, tree, async_=False)
+        assert os.path.isdir(path)
+        _assert_trees_equal(mgr.restore(step=3), tree)
+
+    def test_commit_protocol_layout(self, tmp_path):
+        mgr = cp.CheckpointManager(str(tmp_path))
+        mgr.save(1, _small_tree(), async_=False)
+        step = layout.step_dir(str(tmp_path), 1)
+        assert layout.classify(step) == layout.COMMITTED
+        manifest = layout.read_manifest(step)   # verifies the COMMIT crc
+        assert manifest["format"] == layout.FORMAT
+        assert manifest["step"] == 1
+        # every shard the manifest names exists and checks out
+        for leaf in manifest["leaves"]:
+            for shard in leaf["shards"]:
+                data = open(os.path.join(step, shard["file"]), "rb").read()
+                assert layout.crc32(data) == shard["crc32"]
+                assert len(data) == shard["nbytes"]
+
+    def test_overwrite_needs_force(self, tmp_path):
+        mgr = cp.CheckpointManager(str(tmp_path))
+        mgr.save(1, {"w": jnp.zeros(2)}, async_=False)
+        with pytest.raises(FileExistsError):
+            mgr.save(1, {"w": jnp.zeros(2)}, async_=False)
+        mgr.save(1, {"w": jnp.ones(2)}, async_=False, force=True)
+        np.testing.assert_array_equal(
+            np.asarray(mgr.restore(step=1)["w"]), 1.0)
+
+    def test_overwrite_guard_covers_legacy_dirs(self, tmp_path):
+        """force=False must refuse to clobber an old orbax checkpoint,
+        not just a new-format committed one (the old facade raised)."""
+        import orbax.checkpoint as ocp
+        ocp.PyTreeCheckpointer().save(
+            layout.step_dir(str(tmp_path), 4), {"w": np.zeros(2)})
+        mgr = cp.CheckpointManager(str(tmp_path))
+        with pytest.raises(FileExistsError):
+            mgr.save(4, {"w": jnp.ones(2)}, async_=False)
+        mgr.save(4, {"w": jnp.ones(2)}, async_=False, force=True)
+        np.testing.assert_array_equal(
+            np.asarray(mgr.restore(step=4)["w"]), 1.0)
+
+    def test_restore_target_provides_structure(self, tmp_path):
+        """target rebuilds the tree in the CALLER's structure (the old
+        orbax contract) — data maps by flatten order."""
+        mgr = cp.CheckpointManager(str(tmp_path))
+        mgr.save(1, {"a": jnp.zeros(2), "b": jnp.ones(3)}, async_=False)
+        out = mgr.restore(step=1, target=[0.0, 0.0])   # None leaves vanish
+        assert isinstance(out, list) and len(out) == 2
+        np.testing.assert_array_equal(np.asarray(out[0]), np.zeros(2))
+        np.testing.assert_array_equal(np.asarray(out[1]), np.ones(3))
+        with pytest.raises(cp.IntegrityError, match="leaves"):
+            mgr.restore(step=1, target=[0.0, 0.0, 0.0])
+
+    def test_explicit_missing_step_raises_filenotfound(self, tmp_path):
+        """Satellite bugfix: a never-written explicit step must be a
+        FileNotFoundError naming the directory and step, not an orbax
+        internal error."""
+        mgr = cp.CheckpointManager(str(tmp_path))
+        mgr.save(1, {"w": jnp.zeros(2)}, async_=False)
+        with pytest.raises(FileNotFoundError, match=r"step 42"):
+            mgr.restore(step=42)
+        with pytest.raises(FileNotFoundError, match=r"step 42"):
+            facade.restore(str(tmp_path), step=42)
+
+
+# ---------------------------------------------------------------------------
+# async: snapshot-then-persist
+# ---------------------------------------------------------------------------
+
+class TestAsync:
+    def test_async_save_commits_after_wait(self, tmp_path):
+        tree = _small_tree()
+        mgr = cp.CheckpointManager(str(tmp_path))
+        mgr.save(5, tree)               # async_=True is the manager default
+        mgr.wait_until_finished()
+        assert mgr.latest_step() == 5
+        _assert_trees_equal(mgr.restore(), tree)
+        assert M.snapshot()["hvd_tpu_checkpoint_inflight"] == 0
+
+    def test_async_on_thread_cost_below_sync_save(self, tmp_path):
+        """The acceptance bound: the training thread pays snapshot only;
+        serialize+checksum+fsync+commit moves to the background."""
+        tree = {"w": jnp.zeros(8 * 1024 * 1024, jnp.float32)}   # 32 MB
+        mgr = cp.CheckpointManager(str(tmp_path))
+        t0 = time.perf_counter()
+        mgr.save(1, tree, async_=False)
+        sync_elapsed = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        mgr.save(2, tree, async_=True)
+        async_elapsed = time.perf_counter() - t0
+        mgr.wait_until_finished()
+        assert async_elapsed < sync_elapsed, \
+            f"async on-thread cost {async_elapsed:.4f}s not below sync " \
+            f"save {sync_elapsed:.4f}s"
+        _assert_trees_equal(mgr.restore(step=2), tree)
+
+    @pytest.mark.chaos
+    def test_writer_error_surfaces_on_wait_then_clears(self, tmp_path):
+        mgr = cp.CheckpointManager(str(tmp_path))
+        F.configure("checkpoint.write:error:once", seed=SEED)
+        mgr.save(1, {"w": jnp.zeros(4)})
+        with pytest.raises(OSError, match="injected"):
+            mgr.wait_until_finished()
+        mgr.wait_until_finished()       # error consumed, not sticky
+        # the failed step never became discoverable...
+        assert mgr.latest_step() is None
+        # ...and the pipeline still works afterwards
+        mgr.save(2, {"w": jnp.ones(4)})
+        mgr.wait_until_finished()
+        assert mgr.latest_step() == 2
+
+    @pytest.mark.chaos
+    def test_bounded_inflight_applies_backpressure(self, tmp_path):
+        """With max_inflight=1 and a slowed writer, the queue fills and
+        save() blocks instead of buffering unbounded host snapshots."""
+        mgr = cp.CheckpointManager(str(tmp_path), max_inflight=1)
+        F.configure("checkpoint.write:delay=0.4:times=1", seed=SEED)
+        tree = {"w": jnp.zeros(8)}
+        mgr.save(1, tree)               # writer picks up, sleeps 0.4s
+        mgr.save(2, tree, force=True)   # fills the 1-deep queue
+        t0 = time.perf_counter()
+        mgr.save(3, tree, force=True)   # must block until slot frees
+        blocked = time.perf_counter() - t0
+        mgr.wait_until_finished()
+        assert blocked > 0.1, f"save did not backpressure ({blocked:.3f}s)"
+        assert M.snapshot()["hvd_tpu_checkpoint_inflight"] == 0
+        assert mgr.latest_step() == 3
+
+    @pytest.mark.chaos
+    def test_sync_save_drains_inflight_async_saves_first(self, tmp_path):
+        """_persist (and its GC pass) stays single-threaded per manager:
+        a sync save must wait out the background writer, not race it."""
+        mgr = cp.CheckpointManager(str(tmp_path), keep=2)
+        F.configure("checkpoint.write:delay=0.3:times=1", seed=SEED)
+        mgr.save(1, {"w": jnp.zeros(4)})                # async, slow writer
+        mgr.save(2, {"w": jnp.ones(4)}, async_=False)   # drains, then persists
+        assert sorted(mgr.all_steps()) == [1, 2]
+
+    @pytest.mark.chaos
+    def test_duplicate_queued_step_needs_force(self, tmp_path):
+        """The overwrite guard must also see steps still in the writer
+        queue — on disk the duplicate isn't visible yet."""
+        mgr = cp.CheckpointManager(str(tmp_path))
+        F.configure("checkpoint.write:delay=0.3:times=1", seed=SEED)
+        mgr.save(1, {"w": jnp.zeros(4)})                # queued / in flight
+        with pytest.raises(FileExistsError):
+            mgr.save(1, {"w": jnp.ones(4)})
+        mgr.wait_until_finished()
+        np.testing.assert_array_equal(
+            np.asarray(mgr.restore(step=1)["w"]), 0.0)
+
+    def test_callback_drains_async_saves_on_train_end(self, tmp_path):
+        from horovod_tpu import callbacks as cbs
+        run = cbs.TrainingRun(params={"w": jnp.zeros(2)})
+        cb = cp.CheckpointCallback(str(tmp_path), epochs_per_save=1,
+                                   async_=True)
+        cl = cbs.CallbackList([cb], run)
+        logs = {}
+        for epoch in range(3):
+            cl.on_epoch_end(epoch, logs)
+        cl.on_train_end(logs)           # final epoch's save must land
+        assert logs["checkpoint_step"] == 2
+        assert cp.latest_step(str(tmp_path)) == 2
+
+    def test_drain_all_covers_live_managers(self, tmp_path):
+        """The elastic reset path drains via drain_all(): an in-flight
+        save lands before the process image would go away."""
+        mgr = cp.CheckpointManager(str(tmp_path))
+        mgr.save(4, {"w": jnp.arange(4)})
+        cp.drain_all()
+        assert mgr.latest_step() == 4
+
+
+# ---------------------------------------------------------------------------
+# elastic resharding restore (save at world 4 -> restore at 2 and 1)
+# ---------------------------------------------------------------------------
+
+class TestResharding:
+    def _sharded_tree(self, mesh):
+        x = jax.device_put(jnp.arange(16, dtype=jnp.float32),
+                           NamedSharding(mesh, P("world")))
+        y = jax.device_put(
+            jnp.arange(32, dtype=jnp.float64).reshape(8, 4) / 3.0,
+            NamedSharding(mesh, P("world", None)))
+        rep = jax.device_put(jnp.arange(6, dtype=jnp.int32),
+                             NamedSharding(mesh, P()))
+        return {"x": x, "y": y, "rep": rep}
+
+    def test_save4_restore2_restore1_bit_exact(self, tmp_path):
+        tree = self._sharded_tree(_mesh(4))
+        ref = jax.tree_util.tree_map(np.asarray, tree)
+        mgr = cp.CheckpointManager(str(tmp_path))
+        mgr.save(0, tree, async_=False)
+        manifest = layout.read_manifest(layout.step_dir(str(tmp_path), 0))
+        by_path = {l["path"]: l for l in manifest["leaves"]}
+        assert len(by_path["['x']"]["shards"]) == 4     # 4-way sharded
+        assert len(by_path["['rep']"]["shards"]) == 1   # replicated: 1 owner
+
+        # restore onto a HALVED world (2-device mesh)
+        mesh2 = _mesh(2)
+        sh2 = {"x": NamedSharding(mesh2, P("world")),
+               "y": NamedSharding(mesh2, P("world", None)),
+               "rep": NamedSharding(mesh2, P())}
+        out2 = mgr.restore(step=0, sharding=sh2)
+        for k in ref:
+            assert out2[k].sharding == sh2[k]
+            np.testing.assert_array_equal(np.asarray(out2[k]), ref[k])
+
+        # restore onto a single device (world of 1)
+        mesh1 = _mesh(1)
+        sh1 = jax.tree_util.tree_map(
+            lambda _: NamedSharding(mesh1, P()), ref)
+        out1 = mgr.restore(step=0, sharding=sh1)
+        for k in ref:
+            np.testing.assert_array_equal(np.asarray(out1[k]), ref[k])
+
+        # and plain host restore (no sharding): still bit-exact
+        out_host = mgr.restore(step=0)
+        for k in ref:
+            np.testing.assert_array_equal(np.asarray(out_host[k]), ref[k])
+
+
+# ---------------------------------------------------------------------------
+# integrity: crash-before-COMMIT, checksum corruption, torn manifest
+# ---------------------------------------------------------------------------
+
+def _run_crash_drill(tmp_path):
+    """Commit step 1, inject a writer crash during step 2's persist,
+    return the observable outcome tuple."""
+    mgr = cp.CheckpointManager(str(tmp_path))
+    mgr.save(1, {"w": jnp.arange(8, dtype=jnp.float32)}, async_=False)
+    F.configure("checkpoint.write:crash:once", seed=SEED)
+    injected0 = _counter("hvd_tpu_faults_injected_total"
+                         '{site="checkpoint.write",kind="crash"}')
+    mgr.save(2, {"w": jnp.ones(8, jnp.float32)})    # async
+    err = None
+    try:
+        mgr.wait_until_finished()
+    except cp.CheckpointWriterCrashed as e:
+        err = e
+    F.configure("", seed=0)
+    injected = _counter("hvd_tpu_faults_injected_total"
+                        '{site="checkpoint.write",kind="crash"}') - injected0
+    state2 = layout.classify(layout.step_dir(str(tmp_path), 2))
+    fb0 = _counter("hvd_tpu_checkpoint_fallbacks_total")
+    integ0 = _counter("hvd_tpu_checkpoint_integrity_failures_total")
+    out = mgr.restore(step=2, fallback=True)
+    fb = _counter("hvd_tpu_checkpoint_fallbacks_total") - fb0
+    integ = _counter("hvd_tpu_checkpoint_integrity_failures_total") - integ0
+    return (type(err).__name__, injected, mgr.latest_step(), state2,
+            float(np.asarray(out["w"]).sum()), fb, integ)
+
+
+class TestIntegrity:
+    @pytest.mark.chaos
+    def test_crash_before_commit_falls_back_to_committed_step(self, tmp_path):
+        """The acceptance drill: an injected checkpoint.write crash
+        leaves latest_step on the previous committed step, and the skip
+        is accounted by both counters."""
+        outcome = _run_crash_drill(tmp_path)
+        name, injected, latest, state2, restored_sum, fb, integ = outcome
+        assert name == "CheckpointWriterCrashed"
+        assert injected == 1
+        assert latest == 1                      # step 2 never discoverable
+        assert state2 == layout.PARTIAL         # crashed mid-persist
+        assert restored_sum == float(np.arange(8).sum())   # step 1 payload
+        assert fb == 1 and integ == 1
+
+    @pytest.mark.chaos
+    def test_crash_drill_is_deterministic(self, tmp_path):
+        """Same seed + same spec -> identical drill outcome, replayed."""
+        a = _run_crash_drill(tmp_path / "a")
+        b = _run_crash_drill(tmp_path / "b")
+        assert a == b
+
+    def test_checksum_corruption_detected_and_walked_past(self, tmp_path):
+        tree1 = {"w": jnp.arange(16, dtype=jnp.float32)}
+        mgr = cp.CheckpointManager(str(tmp_path))
+        mgr.save(1, tree1, async_=False)
+        mgr.save(2, {"w": jnp.ones(16, jnp.float32)}, async_=False)
+        # flip one payload byte in a committed shard of step 2
+        step2 = layout.step_dir(str(tmp_path), 2)
+        manifest = layout.read_manifest(step2)
+        shard_path = os.path.join(step2, manifest["leaves"][0]["shards"][0]
+                                  ["file"])
+        blob = bytearray(open(shard_path, "rb").read())
+        blob[3] ^= 0xFF
+        open(shard_path, "wb").write(bytes(blob))
+
+        integ0 = _counter("hvd_tpu_checkpoint_integrity_failures_total")
+        with pytest.raises(cp.IntegrityError, match="checksum"):
+            mgr.restore()                       # no opt-in: surface it
+        assert _counter(
+            "hvd_tpu_checkpoint_integrity_failures_total") == integ0 + 1
+
+        fb0 = _counter("hvd_tpu_checkpoint_fallbacks_total")
+        out = mgr.restore(fallback=True)        # opt-in: walk back
+        _assert_trees_equal(out, tree1)
+        assert _counter("hvd_tpu_checkpoint_fallbacks_total") == fb0 + 1
+        assert _counter(
+            "hvd_tpu_checkpoint_integrity_failures_total") == integ0 + 2
+        # checksum-proven corruption is demoted on walk-past, so the
+        # resumed run's fresh commits outrank it (GC would otherwise
+        # protect the garbage and delete new progress)
+        assert layout.classify(step2) == layout.PARTIAL
+        assert mgr.latest_step() == 1
+
+    def test_torn_manifest_detected_by_commit_crc(self, tmp_path):
+        mgr = cp.CheckpointManager(str(tmp_path))
+        mgr.save(1, {"w": jnp.zeros(4)}, async_=False)
+        step = layout.step_dir(str(tmp_path), 1)
+        mpath = os.path.join(step, layout.MANIFEST_NAME)
+        doctored = open(mpath, "rb").read().replace(b'"step": 1',
+                                                    b'"step": 9')
+        open(mpath, "wb").write(doctored)
+        with pytest.raises(cp.IntegrityError, match="manifest"):
+            mgr.restore(step=1)
+
+    def test_partial_dir_never_discoverable(self, tmp_path):
+        mgr = cp.CheckpointManager(str(tmp_path))
+        mgr.save(1, {"w": jnp.zeros(2)}, async_=False)
+        # a crashed save: shards present, no COMMIT
+        os.makedirs(tmp_path / "step_0000000002" / "shards")
+        assert mgr.latest_step() == 1
+        assert facade.latest_step(str(tmp_path)) == 1
+        # legacy (pre-manifest) dirs still count — facade compat
+        os.makedirs(tmp_path / "step_0000000003")
+        assert facade.latest_step(str(tmp_path)) == 3
+
+
+# ---------------------------------------------------------------------------
+# retention GC
+# ---------------------------------------------------------------------------
+
+class TestRetentionGC:
+    def test_retained_steps_policy(self):
+        steps = list(range(10))
+        assert cgc.retained_steps(steps, keep=2, keep_period=4) == \
+            {0, 4, 8, 9}
+        assert cgc.retained_steps(steps) == set(steps)          # no policy
+        assert cgc.retained_steps(steps, keep=3) == {7, 8, 9}
+        assert cgc.retained_steps(steps, keep_period=5) == {0, 5, 9}
+        assert cgc.retained_steps([], keep=2) == set()
+
+    def test_gc_keeps_exactly_the_policy_set(self, tmp_path):
+        mgr = cp.CheckpointManager(str(tmp_path), keep=2, keep_period=4)
+        removed0 = _counter("hvd_tpu_checkpoint_gc_removed_total")
+        for s in range(10):
+            mgr.save(s, {"w": jnp.full(4, s, jnp.float32)})
+        mgr.wait_until_finished()
+        assert sorted(mgr.all_steps()) == [0, 4, 8, 9]
+        assert _counter("hvd_tpu_checkpoint_gc_removed_total") - removed0 \
+            == 6
+        # the survivors restore fine after their neighbors were deleted
+        np.testing.assert_array_equal(
+            np.asarray(mgr.restore(step=4)["w"]), 4.0)
+
+    def test_gc_sweeps_stale_partial_dirs(self, tmp_path):
+        os.makedirs(tmp_path / "step_0000000001" / "shards")   # crashed save
+        mgr = cp.CheckpointManager(str(tmp_path), keep=2)
+        mgr.save(2, {"w": jnp.zeros(2)}, async_=False)
+        mgr.save(3, {"w": jnp.zeros(2)}, async_=False)
+        assert not (tmp_path / "step_0000000001").exists()
+
+    @pytest.mark.chaos
+    def test_gc_fault_never_fails_the_save(self, tmp_path):
+        F.configure("checkpoint.gc:error:once", seed=SEED)
+        mgr = cp.CheckpointManager(str(tmp_path), keep=1)
+        mgr.save(1, {"w": jnp.zeros(2)}, async_=False)
+        mgr.save(2, {"w": jnp.zeros(2)}, async_=False)  # gc pass injected
+        mgr.wait_until_finished()
+        assert mgr.latest_step() == 2
+
+
+# ---------------------------------------------------------------------------
+# facade + metrics surface
+# ---------------------------------------------------------------------------
+
+class TestFacadeAndMetrics:
+    def test_facade_is_the_package(self):
+        assert facade.CheckpointCallback is cp.CheckpointCallback
+        assert facade.save is cp.save
+        assert facade.restore is cp.restore
+        assert facade.latest_step is cp.latest_step
+
+    def test_facade_roundtrip_and_steps_helper(self, tmp_path):
+        tree = _small_tree()
+        facade.save(str(tmp_path), 2, tree)
+        _assert_trees_equal(facade.restore(str(tmp_path)), tree)
+        assert facade._steps(str(tmp_path)) == [2]
+
+    def test_legacy_orbax_checkpoint_restores_through_facade(self, tmp_path):
+        import orbax.checkpoint as ocp
+        tree = {"w": np.arange(6, dtype=np.float32)}
+        ocp.PyTreeCheckpointer().save(
+            layout.step_dir(str(tmp_path), 4), tree)
+        assert facade.latest_step(str(tmp_path)) == 4
+        np.testing.assert_array_equal(
+            np.asarray(facade.restore(str(tmp_path))["w"]), tree["w"])
+
+    def test_save_metrics_families_populate(self, tmp_path):
+        mgr = cp.CheckpointManager(str(tmp_path))
+        bytes0 = _counter("hvd_tpu_checkpoint_bytes_total")
+        mgr.save(1, {"w": jnp.zeros(1024, jnp.float64)}, async_=False)
+        snap = M.snapshot()
+        assert snap["hvd_tpu_checkpoint_bytes_total"] - bytes0 >= 8192
+        for phase in ("snapshot", "persist"):
+            hist = snap[f'hvd_tpu_checkpoint_save_seconds{{phase="{phase}"}}']
+            assert hist["count"] >= 1

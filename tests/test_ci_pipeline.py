@@ -119,6 +119,22 @@ def test_chaos_coordinator_suite_is_seeded_and_exclusive():
         os.path.join(root, "tests", "test_coordinator_recovery.py"))
 
 
+def test_checkpoint_suite_is_seeded_and_exclusive():
+    """The checkpointing drills (writer crash, corruption walk-back, GC)
+    run as their own seeded CI suite; the generic unit and chaos suites
+    must not run the same file twice."""
+    by_name = {name: cmd for name, cmd, _t in COMMON_SUITES}
+    assert "checkpoint" in by_name
+    cmd = by_name["checkpoint"]
+    assert "HVD_TPU_FAULT_SEED=" in cmd
+    assert "tests/test_checkpointing.py" in cmd
+    assert "--ignore=tests/test_checkpointing.py" in by_name["unit"]
+    assert "--ignore=tests/test_checkpointing.py" in by_name["chaos"]
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    assert os.path.exists(
+        os.path.join(root, "tests", "test_checkpointing.py"))
+
+
 def test_check_knobs_lint_is_clean():
     """The knob lint must pass on the tree as committed: every HVD_TPU_*
     env var read in the package is registered in config.py and documented
