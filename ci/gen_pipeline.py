@@ -25,14 +25,16 @@ COMMON_SUITES = [
     ("lint-knobs", "python tools/check_knobs.py", 5),
     # chaos tests are excluded here because the chaos suite below is
     # their single owner — without the filter every fast chaos test
-    # would run twice per service; the checkpoint suite likewise owns
-    # tests/test_checkpointing.py exclusively
+    # would run twice per service; the checkpoint and serving suites
+    # likewise own their test files exclusively
     ("unit",
      "python -m pytest tests/ -q -m 'not integration and not chaos' "
-     "--ignore=tests/test_checkpointing.py", 30),
+     "--ignore=tests/test_checkpointing.py "
+     "--ignore=tests/test_serving.py", 30),
     ("chaos", "python -m pytest tests/ -q -m chaos "
      "--ignore=tests/test_coordinator_recovery.py "
-     "--ignore=tests/test_checkpointing.py", 20),
+     "--ignore=tests/test_checkpointing.py "
+     "--ignore=tests/test_serving.py", 20),
     # coordinator-kill + heartbeat-timeout drills, seeded so every run
     # replays the same fault schedule; owns its test file exclusively
     # (the generic chaos suite ignores it to avoid double runs)
@@ -45,6 +47,13 @@ COMMON_SUITES = [
     ("checkpoint",
      "env HVD_TPU_FAULT_SEED=1234 "
      "python -m pytest tests/test_checkpointing.py -q", 20),
+    # inference serving: micro-batch coalescing, admission-control
+    # backpressure, checkpoint hot-reload, and the seeded forward/reload
+    # chaos drills — pinned seed; owns its file exclusively (unit+chaos
+    # suites ignore it)
+    ("serving",
+     "env HVD_TPU_FAULT_SEED=1234 "
+     "python -m pytest tests/test_serving.py -q", 20),
     ("multiproc",
      "python -m pytest tests/test_multiprocess_integration.py -q", 30),
     ("elastic", "python -m pytest tests/test_elastic_e2e.py -q", 40),

@@ -81,7 +81,7 @@ def __getattr__(name):
         from .estimator import Estimator
         return Estimator
     if name in ("callbacks", "torch", "data", "checkpoint", "checkpointing",
-                "tensorflow", "keras", "spark"):
+                "serving", "tensorflow", "keras", "spark"):
         # importlib, not `from . import x`: the fromlist lookup re-enters
         # this __getattr__ before sys.modules is populated (see `elastic`)
         import importlib

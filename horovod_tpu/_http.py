@@ -1,0 +1,104 @@
+"""Shared stdlib HTTP-server plumbing.
+
+Three subsystems front themselves with the same threaded stdlib server
+idiom — the rendezvous KV store (``runner/rendezvous.py``), the
+Prometheus metrics endpoint (``metrics.py``), and the inference serving
+front-end (``serving/server.py``). Before this module each carried its
+own copy of the same four decisions:
+
+* ``ThreadingHTTPServer`` with ``daemon_threads`` (a wedged client must
+  never block process exit) and ``block_on_close = False`` (a live
+  long-polling handler must not deadlock ``server_close()``);
+* quiet logging — request lines and handler tracebacks are not log
+  events unless the operator asked for verbosity;
+* a daemon serving thread with a tight ``poll_interval`` so shutdown
+  costs ~50ms, not ``serve_forever``'s default 0.5s;
+* an **idempotent** stop that survives concurrent callers (shutdown +
+  close + join exactly once).
+
+Owners attach their state directly on the server object (``httpd.owner``
+and friends) — the same pattern as the KV store — so handlers stay
+plain ``BaseHTTPRequestHandler`` subclasses.
+"""
+
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+
+class QuietHandler(BaseHTTPRequestHandler):
+    """Handler base: HTTP/1.1 keep-alive, logging gated on the server's
+    ``verbose`` flag (a scrape or an inference request is not a log
+    event)."""
+
+    protocol_version = "HTTP/1.1"
+
+    def log_message(self, fmt, *args):
+        if getattr(self.server, "verbose", False):
+            super().log_message(fmt, *args)
+
+
+class QuietThreadingHTTPServer(ThreadingHTTPServer):
+    """Threaded server base shared by every horovod_tpu HTTP front-end."""
+
+    #: never join handler threads on close: a live blocking GET (the KV
+    #: store's ``rank_and_size`` long-poll, an inference request waiting
+    #: on its batch) must not deadlock stop()/crash simulation
+    block_on_close = False
+    daemon_threads = True
+    #: handlers and ``handle_error`` consult this; set by start_server()
+    verbose = False
+
+    def handle_error(self, request, client_address):
+        # dropped connections are EXPECTED (impatient clients, injected
+        # crash faults); only show tracebacks when the operator asked
+        if getattr(self, "verbose", False):
+            super().handle_error(request, client_address)
+
+
+def start_server(handler_cls, port: int = 0, addr: str = "0.0.0.0",
+                 name: str = "hvd-tpu-http", verbose: bool = False,
+                 poll_interval: float = 0.05,
+                 server_cls=QuietThreadingHTTPServer):
+    """Bind ``addr:port`` (0 = ephemeral), serve ``handler_cls`` on a
+    daemon thread, and return the server object. The bound port is
+    ``server.server_address[1]``; tear down with :func:`stop_server`."""
+    httpd = server_cls((addr, int(port)), handler_cls)
+    httpd.verbose = verbose
+    thread = threading.Thread(
+        target=lambda: httpd.serve_forever(poll_interval=poll_interval),
+        name=name, daemon=True)
+    httpd._hvd_thread = thread
+    httpd._hvd_stop_lock = threading.Lock()
+    httpd._hvd_stopped = False
+    thread.start()
+    return httpd
+
+
+def stop_server(httpd, timeout: Optional[float] = 5.0) -> None:
+    """Idempotent teardown: exactly one caller (of any number, from any
+    thread) shuts the server down and joins the serving thread; the rest
+    — including repeat calls — return immediately. ``None`` is accepted
+    so owners can stop an endpoint that never started."""
+    if httpd is None:
+        return
+    lock = getattr(httpd, "_hvd_stop_lock", None)
+    if lock is None:                  # not started via start_server()
+        try:
+            httpd.shutdown()
+            httpd.server_close()
+        except Exception:
+            pass
+        return
+    with lock:
+        if httpd._hvd_stopped:
+            return
+        httpd._hvd_stopped = True
+    try:
+        httpd.shutdown()
+        httpd.server_close()
+    except Exception:
+        pass
+    thread = getattr(httpd, "_hvd_thread", None)
+    if thread is not None and thread is not threading.current_thread():
+        thread.join(timeout=timeout)

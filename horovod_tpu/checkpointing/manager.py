@@ -137,15 +137,9 @@ class _Pending:
         self.path = path
 
 
-def _live_config() -> "_config.Config":
-    """The initialized world's Config (programmatic overrides included),
-    falling back to an env-only view — the same resolution order
-    ``config.describe()`` reports, so a ``Config.set()`` override can
-    never be silently ignored here."""
-    from .. import basics
-    if basics.is_initialized():
-        return basics.world().config
-    return _config.Config()
+#: shared live-world knob lookup (config.live_config); kept under the
+#: old private name for this module's existing call sites
+_live_config = _config.live_config
 
 
 def _process_count() -> int:

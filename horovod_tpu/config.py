@@ -357,6 +357,61 @@ CHECKPOINT_KEEP_PERIOD = _register(
          "(milestone checkpoints for offline eval), regardless of "
          "HVD_TPU_CHECKPOINT_KEEP. 0 (default) disables the rule.")
 
+# -- Inference serving (no reference equivalent — the reference stops at
+#    training; serving/ is the request-to-batch inference plane: dynamic
+#    micro-batching, admission control, checkpoint hot-reload) ---------------
+SERVING_MAX_BATCH = _register(
+    "SERVING_MAX_BATCH", 8, int,
+    help="Largest micro-batch (rows) the serving batcher coalesces "
+         "concurrent requests into — the top shape bucket, so it bounds "
+         "both latency amortization and the padded-forward cost. Must "
+         "cover the largest single request.")
+SERVING_BATCH_TIMEOUT_MS = _register(
+    "SERVING_BATCH_TIMEOUT_MS", 5.0, float,
+    help="Milliseconds the batcher holds an open micro-batch waiting for "
+         "more requests before dispatching it. The latency/throughput "
+         "dial: 0 dispatches every request alone (lowest latency, no "
+         "coalescing), larger values fill bigger buckets under load.")
+SERVING_BUCKETS = _register(
+    "SERVING_BUCKETS", "", str,
+    help="Comma-separated static batch-shape buckets (rows) the serving "
+         "batcher pads micro-batches to, e.g. '1,2,4,8'. Compiled SPMD "
+         "forwards need static shapes; each bucket costs one compile "
+         "(cached, optionally warmed). Empty (default) = powers of two "
+         "up to HVD_TPU_SERVING_MAX_BATCH.")
+SERVING_QUEUE_DEPTH = _register(
+    "SERVING_QUEUE_DEPTH", 64, int,
+    help="Admission control: bound on requests queued ahead of the "
+         "serving batcher. A request arriving at a full queue is "
+         "rejected immediately (HTTP 503) instead of growing an "
+         "unbounded backlog every queued request would time out in — "
+         "overload degrades to fast backpressure, not collapse.")
+SERVING_DEADLINE_MS = _register(
+    "SERVING_DEADLINE_MS", 2000.0, float,
+    help="Default per-request deadline in milliseconds (callers can set "
+         "a per-request value). A request whose deadline expires before "
+         "its micro-batch is formed is answered HTTP 429 without "
+         "touching the device; expiry checks happen at admission and "
+         "at batch formation. 0 disables deadlines.")
+SERVING_PORT = _register(
+    "SERVING_PORT", 0, int,
+    help="Port for the inference HTTP front-end (POST /v1/infer, GET "
+         "/healthz). 0 (default) binds an ephemeral port (the server "
+         "reports it); the engine API works without the HTTP layer.")
+SERVING_RELOAD_POLL_SECONDS = _register(
+    "SERVING_RELOAD_POLL_SECONDS", 10.0, float,
+    help="Seconds between checkpoint-directory polls for serving "
+         "hot-reload: when latest_step() moves past the serving step, "
+         "the engine restores the new step in the background and "
+         "atomically swaps it in without dropping in-flight requests. "
+         "0 disables polling (hot-reload stays available via "
+         "InferenceEngine.reload()).")
+SERVING_WARMUP = _register(
+    "SERVING_WARMUP", True, _parse_bool,
+    help="Compile every serving shape bucket at engine start with "
+         "zero-filled inputs, so no live request pays an XLA compile. "
+         "Set 0 to trade first-request latency for faster startup.")
+
 # -- Misc -------------------------------------------------------------------
 NUM_STREAMS = _register(
     "NUM_STREAMS", 1, int, alias="HOROVOD_NUM_NCCL_STREAMS",
@@ -419,6 +474,17 @@ class Config:
 def knobs() -> Dict[str, Knob]:
     """All registered knobs (used by the launcher to build CLI flags)."""
     return dict(_REGISTRY)
+
+
+def live_config() -> "Config":
+    """The initialized world's Config (programmatic overrides included),
+    falling back to an env-only view — the same resolution order
+    ``describe()`` reports, so a ``Config.set()`` override can never be
+    silently ignored by a subsystem reading knobs outside ``init()``."""
+    from . import basics
+    if basics.is_initialized():
+        return basics.world().config
+    return Config()
 
 
 def describe(cfg: Optional[Config] = None) -> str:

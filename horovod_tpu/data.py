@@ -42,12 +42,52 @@ def shard_dataset(arrays, rank: Optional[int] = None,
     return arrays[rank::size]
 
 
+def pad_to_size(arrays, target: int):
+    """Zero-pad each array's leading dimension up to ``target`` rows.
+
+    Returns ``(padded, mask)`` where ``mask`` is a ``(target,)`` bool
+    array marking the real rows. This is the pad-to-bucket primitive
+    shared by :func:`batches(pad_remainder=True) <batches>` and the
+    serving micro-batcher (:mod:`horovod_tpu.serving.batcher`): compiled
+    SPMD programs need static shapes, so ragged tails are padded to a
+    static size and the mask says which rows are live.
+    """
+    single = not isinstance(arrays, (list, tuple))
+    arrs = [arrays] if single else list(arrays)
+    n = len(arrs[0])
+    if any(len(a) != n for a in arrs):
+        raise ValueError("all arrays must share the first dimension")
+    if n > target:
+        raise ValueError(f"cannot pad {n} rows down to {target}")
+    padded = []
+    for a in arrs:
+        a = np.asarray(a)
+        if n == target:
+            padded.append(a)
+        else:
+            width = [(0, target - n)] + [(0, 0)] * (a.ndim - 1)
+            padded.append(np.pad(a, width))
+    mask = np.zeros(target, dtype=bool)
+    mask[:n] = True
+    out = padded[0] if single else type(arrays)(padded)
+    return out, mask
+
+
 def batches(arrays, batch_size: int, shuffle: bool = True,
-            seed: int = 0, drop_remainder: bool = True) -> Iterator:
+            seed: int = 0, drop_remainder: bool = True,
+            pad_remainder: bool = False) -> Iterator:
     """Yield minibatch tuples from equal-length arrays. The remainder is
     dropped by default: compiled SPMD steps need static shapes (the
     reference instead pads/Joins on uneven data; Join remains available for
-    the eager plane)."""
+    the eager plane).
+
+    ``pad_remainder=True`` keeps the tail without breaking static shapes:
+    every yielded batch carries a trailing ``(batch_size,)`` bool validity
+    mask (all-True for full batches, so the compiled step sees one shape),
+    and the final ragged batch is zero-padded to ``batch_size`` with its
+    mask marking the real rows — mask the loss with it. Overrides
+    ``drop_remainder``.
+    """
     single = not isinstance(arrays, (list, tuple))
     arrs = [arrays] if single else list(arrays)
     n = len(arrs[0])
@@ -56,11 +96,17 @@ def batches(arrays, batch_size: int, shuffle: bool = True,
     idx = np.arange(n)
     if shuffle:
         np.random.RandomState(seed).shuffle(idx)
+    if pad_remainder:
+        drop_remainder = False
     stop = (n // batch_size) * batch_size if drop_remainder else n
     for lo in range(0, stop, batch_size):
         sel = idx[lo:lo + batch_size]
         out = tuple(a[sel] for a in arrs)
-        yield out[0] if single else out
+        if pad_remainder:
+            out, mask = pad_to_size(out, batch_size)
+            yield out + (mask,)
+        else:
+            yield out[0] if single else out
 
 
 class PrefetchIterator:

@@ -58,6 +58,7 @@ class Estimator:
         self.params = None
         self._opt = None
         self._opt_state = None
+        self._predict_cache = None
 
     # -- internals -----------------------------------------------------------
     def _default_loss(self):
@@ -177,9 +178,28 @@ class Estimator:
         return out
 
     def predict(self, x):
+        """Forward pass on ``x`` (leading dim = batch), returned unpadded.
+
+        Inputs are zero-padded to power-of-two buckets and run through a
+        per-bucket jit cache (:class:`horovod_tpu.serving.batcher.
+        BucketedForward`, the serving batcher's engine), so repeated
+        predicts of varying sizes hit a handful of compiled programs
+        instead of recompiling per distinct length. The returned rows are
+        exactly the old eager ``model.apply`` values (padding rows are
+        computed and discarded; the model must be row-wise, which every
+        batched-inference model is)."""
         if self.params is None:
             raise RuntimeError("call fit() before predict()")
-        return self.model.apply(self.params, np.asarray(x))
+        x = np.asarray(x)
+        if x.ndim < 2:
+            # a single unbatched sample: no leading batch dim to bucket
+            # (padding it would slice the wrong axis) — apply directly,
+            # the historical behavior
+            return self.model.apply(self.params, x)
+        if self._predict_cache is None:
+            from .serving.batcher import BucketedForward
+            self._predict_cache = BucketedForward(self.model.apply)
+        return self._predict_cache.apply_padded(self.params, x)
 
     # -- persistence (reference: estimator Store / model transformer) --------
     def save(self, directory: str, step: int = 0):

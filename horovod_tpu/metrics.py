@@ -479,17 +479,21 @@ def start_http_server(port: int, addr: str = "0.0.0.0",
                       registry: Optional[Registry] = None):
     """Serve ``GET /metrics`` (Prometheus text format) on ``port``.
     Returns the server object; ``stop_http_server(server)`` tears it
-    down. A daemon thread serves, so a wedged scraper never blocks
+    down. A daemon thread serves (shared stdlib plumbing in
+    :mod:`horovod_tpu._http`), so a wedged scraper never blocks
     training."""
-    import http.server
+    from . import _http
 
     reg = registry or REGISTRY
 
-    class _Handler(http.server.BaseHTTPRequestHandler):
+    class _Handler(_http.QuietHandler):
         def do_GET(self):  # noqa: N802 — BaseHTTPRequestHandler contract
             path = self.path.split("?", 1)[0]
             if path not in ("/metrics", "/"):
                 self.send_response(404)
+                # HTTP/1.1 keep-alive (QuietHandler): a bodyless reply
+                # still needs an explicit length or the client hangs
+                self.send_header("Content-Length", "0")
                 self.end_headers()
                 return
             body = reg.render_prometheus().encode("utf-8")
@@ -500,28 +504,13 @@ def start_http_server(port: int, addr: str = "0.0.0.0",
             self.end_headers()
             self.wfile.write(body)
 
-        def log_message(self, *args):  # scrapes are not log events
-            pass
-
-    server = http.server.ThreadingHTTPServer((addr, int(port)), _Handler)
-    thread = threading.Thread(target=server.serve_forever,
-                              name="hvd-tpu-metrics-http", daemon=True)
-    thread.start()
-    server._hvd_thread = thread
-    return server
+    return _http.start_server(_Handler, port=port, addr=addr,
+                              name="hvd-tpu-metrics-http")
 
 
 def stop_http_server(server) -> None:
-    if server is None:
-        return
-    try:
-        server.shutdown()
-        server.server_close()
-    except Exception:
-        pass
-    t = getattr(server, "_hvd_thread", None)
-    if t is not None:
-        t.join(timeout=5)
+    from . import _http
+    _http.stop_server(server)
 
 
 def configure(world):

@@ -29,11 +29,11 @@ import logging
 import os
 import threading
 import time
-from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Callable, Dict, Optional, Tuple
 from urllib.request import Request, urlopen
 from urllib.error import HTTPError, URLError
 
+from .. import _http
 from .. import config as _config
 from .. import faults as _faults
 from .. import metrics as _metrics
@@ -63,13 +63,7 @@ _M_REPLAYED = _metrics.counter(
     "coordinator (re)start.")
 
 
-class _KVHandler(BaseHTTPRequestHandler):
-    protocol_version = "HTTP/1.1"
-
-    def log_message(self, fmt, *args):  # silence default stderr logging
-        if getattr(self.server, "verbose", False):
-            super().log_message(fmt, *args)
-
+class _KVHandler(_http.QuietHandler):
     def _split(self) -> Tuple[str, str]:
         parts = self.path.strip("/").split("/", 1)
         scope = parts[0] if parts else ""
@@ -134,18 +128,10 @@ class _KVHandler(BaseHTTPRequestHandler):
         self._respond(200)
 
 
-class _KVServer(ThreadingHTTPServer):
-    #: never join handler threads on close: a live ``rank_and_size`` GET
-    #: blocks in the worker-state registry until its generation forms, and
-    #: a crash simulation (or stop()) must not deadlock behind it
-    block_on_close = False
-    daemon_threads = True
-
-    def handle_error(self, request, client_address):
-        # Dropped connections are EXPECTED under crash faults; only show
-        # tracebacks when the operator asked for verbosity.
-        if getattr(self, "verbose", False):
-            super().handle_error(request, client_address)
+class _KVServer(_http.QuietThreadingHTTPServer):
+    """Shared quiet/threaded/no-join-on-close server base (_http.py);
+    the KV store owns its own bind/restart lifecycle, so only the server
+    class is reused here, not start_server()."""
 
 
 #: launcher-side fault site: an ``error`` makes the store answer 503 (a

@@ -1,0 +1,37 @@
+"""Inference serving: the request-to-batch plane.
+
+Training ends at a checkpoint; production starts at a request. This
+package is the layer between them, built on the same substrate the
+training side already trusts:
+
+* :mod:`.batcher` — bounded admission queue + dynamic micro-batcher
+  that coalesces concurrent requests into static-shape-bucketed,
+  jit-cached forward passes (the serving analogue of the reference's
+  background-thread tensor fusion);
+* :mod:`.engine` — :class:`InferenceEngine`: restores params onto the
+  serving mesh via the resharding checkpoint reader and hot-reloads
+  newer committed steps with an atomic swap (zero downtime, in-flight
+  requests never split across checkpoints);
+* :mod:`.server` — :class:`InferenceServer`: threaded stdlib HTTP
+  front-end (``POST /v1/infer``, ``GET /healthz``) where admission
+  control degrades overload to fast 429/503 backpressure.
+
+Quick start::
+
+    import horovod_tpu.serving as serving
+
+    engine = serving.InferenceEngine(
+        model.apply, checkpoint_dir="/ckpts/run1",
+        sharding=serving_sharding, example=np.zeros((8,), np.float32))
+    with serving.InferenceServer(engine, port=8500):
+        ...   # POST /v1/infer {"inputs": [[...], ...]}
+
+See docs/inference.md for the architecture, knobs, metrics, and the
+chaos-drill recipes.
+"""
+
+from .batcher import (BucketedForward, DeadlineExceededError,  # noqa: F401
+                      MicroBatcher, QueueFullError, RejectedError,
+                      bucket_for, parse_buckets)
+from .engine import InferenceEngine, ReloadCrashed, wait_for_step  # noqa: F401
+from .server import InferenceServer                               # noqa: F401

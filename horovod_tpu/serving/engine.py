@@ -1,0 +1,272 @@
+"""The inference engine: params lifecycle + the batched forward.
+
+:class:`InferenceEngine` glues three substrates together:
+
+* **restore onto a serving mesh** — params come from
+  :mod:`horovod_tpu.checkpointing` via
+  ``restore(step, sharding=serving_sharding)``: shards reassemble by
+  global offsets, so a checkpoint saved on a training pod restores onto
+  whatever mesh serves (the PR-4 resharding contract);
+* **dynamic micro-batching** — requests flow through a
+  :class:`~horovod_tpu.serving.batcher.MicroBatcher` into a
+  :class:`~horovod_tpu.serving.batcher.BucketedForward` (static shape
+  buckets, per-bucket jit cache, optional warmup);
+* **zero-downtime checkpoint hot-reload** — a background thread polls
+  ``latest_step()`` every ``HVD_TPU_SERVING_RELOAD_POLL_SECONDS``;
+  when training commits a newer step, the engine restores it *in the
+  background* and swaps the params reference atomically. The forward
+  snapshots that reference once per micro-batch, so every request is
+  answered entirely by one checkpoint — in-flight requests are never
+  dropped or split across versions. A reload that fails (corrupt step,
+  injected ``serving.reload`` fault, crash mid-restore) leaves the old
+  params serving and retries on the next poll.
+
+Fault sites: ``serving.forward`` (each micro-batch forward) and
+``serving.reload`` (each hot-reload attempt; ``crash`` kills the
+*reloader component* mid-swap the way ``checkpoint.write:crash`` kills
+the checkpoint writer — the engine must keep serving the old params).
+"""
+
+import logging
+import threading
+import time
+from typing import Any, Callable, Optional, Sequence
+
+import numpy as np
+
+from .. import config as _config
+from .. import faults as _faults
+from .. import metrics as _metrics
+from .batcher import BucketedForward, MicroBatcher, parse_buckets
+
+log = logging.getLogger("horovod_tpu.serving")
+
+_M_HOT_SWAPS = _metrics.counter(
+    "hvd_tpu_serving_hot_swaps_total",
+    "Checkpoint hot-reloads completed: a newer committed step was "
+    "restored in the background and atomically swapped into serving "
+    "without dropping in-flight requests.")
+_M_STEP = _metrics.gauge(
+    "hvd_tpu_serving_checkpoint_step",
+    "Checkpoint step currently serving (-1 = params were supplied "
+    "directly, not restored from a checkpoint directory).")
+
+_FP_FORWARD = _faults.FaultPoint("serving.forward")
+_FP_RELOAD = _faults.FaultPoint("serving.reload", exc=OSError)
+
+
+class ReloadCrashed(RuntimeError):
+    """An injected ``serving.reload:crash`` fault killed the reloader
+    component mid-reload. The swap never happened; the previous params
+    keep serving (the hot-reload drill's assertion)."""
+
+
+def _reload_crash() -> None:
+    raise ReloadCrashed(
+        "serving hot-reload killed mid-swap (injected crash)")
+
+
+class InferenceEngine:
+    """Serve ``apply_fn(params, x)`` with micro-batching and hot-reload.
+
+    Args:
+      apply_fn: the forward, e.g. ``model.apply`` — must be row-wise
+        (padding rows must not perturb live rows' outputs).
+      checkpoint_dir: restore params from here (latest committed step by
+        default) and hot-reload newer steps as training commits them.
+      params: serve these params directly (no checkpoint lifecycle);
+        exactly one of ``params`` / ``checkpoint_dir`` is required.
+      sharding: target sharding for restored/supplied params — the
+        serving mesh's NamedSharding (or a matching pytree of them);
+        ``None`` serves from the default device.
+      example: one input row (no batch dim) — enables bucket warmup at
+        start when ``HVD_TPU_SERVING_WARMUP`` is on, so no live request
+        pays an XLA compile.
+
+    Knob-backed arguments (``max_batch``, ``batch_timeout_ms``,
+    ``buckets``, ``queue_depth``, ``deadline_ms``,
+    ``reload_poll_seconds``, ``warmup``) default to their registered
+    serving knobs (docs/configuration.md).
+    """
+
+    def __init__(self, apply_fn: Callable, checkpoint_dir: Optional[str] = None,
+                 params: Any = None, sharding=None, step: Optional[int] = None,
+                 example: Optional[np.ndarray] = None,
+                 max_batch: Optional[int] = None,
+                 batch_timeout_ms: Optional[float] = None,
+                 buckets: Optional[Sequence[int]] = None,
+                 queue_depth: Optional[int] = None,
+                 deadline_ms: Optional[float] = None,
+                 reload_poll_seconds: Optional[float] = None,
+                 warmup: Optional[bool] = None):
+        if (params is None) == (checkpoint_dir is None):
+            raise ValueError(
+                "provide exactly one of params= or checkpoint_dir=")
+        cfg = _config.live_config()
+        self.checkpoint_dir = checkpoint_dir
+        self._sharding = sharding
+        self._reload_poll = float(
+            cfg.get(_config.SERVING_RELOAD_POLL_SECONDS)
+            if reload_poll_seconds is None else reload_poll_seconds)
+        self._warmup = bool(cfg.get(_config.SERVING_WARMUP)
+                            if warmup is None else warmup)
+        self._example = None if example is None else np.asarray(example)
+
+        self._params_lock = threading.Lock()
+        self._reload_lock = threading.Lock()
+        self._stop = threading.Event()
+        self._poller: Optional[threading.Thread] = None
+        self._manager = None
+        if checkpoint_dir is not None:
+            from ..checkpointing import CheckpointManager
+            self._manager = CheckpointManager(checkpoint_dir)
+            if step is None:
+                step = self._manager.latest_step()
+                if step is None:
+                    raise FileNotFoundError(
+                        f"no committed checkpoints under {checkpoint_dir!r}")
+            params = self._manager.restore(step=step, sharding=sharding)
+            self.step = int(step)
+        else:
+            if sharding is not None:
+                import jax
+                params = jax.device_put(params, sharding)
+            self.step = -1
+        self._params = params
+        _M_STEP.set(self.step)
+
+        resolved_max = int(cfg.get(_config.SERVING_MAX_BATCH)
+                           if max_batch is None else max_batch)
+        bucket_list = tuple(buckets) if buckets else parse_buckets(
+            cfg.get(_config.SERVING_BUCKETS), resolved_max)
+        self._bucketed = BucketedForward(apply_fn, buckets=bucket_list)
+        self._batcher = MicroBatcher(
+            self._forward, max_batch=resolved_max,
+            timeout_ms=batch_timeout_ms, buckets=bucket_list,
+            queue_depth=queue_depth, default_deadline_ms=deadline_ms,
+            row_shape=None if self._example is None
+            else self._example.shape)
+        if self._warmup and self._example is not None:
+            self._bucketed.warmup(self._params, self._example.shape,
+                                  dtype=self._example.dtype)
+        if self._manager is not None and self._reload_poll > 0:
+            self._poller = threading.Thread(
+                target=self._poll_loop, name="hvd-tpu-serving-reload",
+                daemon=True)
+            self._poller.start()
+
+    # -- serving -------------------------------------------------------------
+
+    def _forward(self, x_padded, n_valid: int):
+        """One micro-batch forward. The (params, step) pair is read under
+        one lock, so a concurrent hot-swap can never split this batch
+        across two checkpoints — and the step returned as batch metadata
+        is the one that actually produced the outputs."""
+        _FP_FORWARD.fire()
+        with self._params_lock:
+            params, step = self._params, self.step
+        return self._bucketed(params, x_padded), step
+
+    def infer(self, x, deadline_ms: Optional[float] = None,
+              timeout: Optional[float] = None):
+        """Synchronous inference: rows in, rows out (unpadded). Raises
+        :class:`~horovod_tpu.serving.batcher.QueueFullError` /
+        :class:`~horovod_tpu.serving.batcher.DeadlineExceededError`
+        under overload — callers (the HTTP front-end) map them to
+        503/429."""
+        return self._batcher.infer(x, deadline_ms=deadline_ms,
+                                   timeout=timeout)
+
+    def infer_with_step(self, x, deadline_ms: Optional[float] = None,
+                        timeout: Optional[float] = None):
+        """:meth:`infer` plus the checkpoint step whose params produced
+        the outputs (NOT necessarily ``self.step``, which a hot-swap may
+        have already moved past by the time the caller reads it)."""
+        req = self._batcher.submit(x, deadline_ms=deadline_ms)
+        out, step = self._batcher.result_with_meta(req, timeout=timeout)
+        return out, (self.step if step is None else step)
+
+    @property
+    def params(self):
+        with self._params_lock:
+            return self._params
+
+    @property
+    def queue_depth(self) -> int:
+        return self._batcher.queue_depth
+
+    @property
+    def batcher(self) -> MicroBatcher:
+        return self._batcher
+
+    # -- hot-reload ----------------------------------------------------------
+
+    def reload(self, step: Optional[int] = None) -> bool:
+        """Load ``step`` (default: latest committed) and atomically swap
+        it into serving. Returns True when a swap happened. Everything
+        expensive (disk read, checksum verify, device_put) runs before
+        the swap, outside the params lock; the swap itself is one
+        reference assignment. Exceptions propagate — the poll loop (and
+        any caller that wants old-params-keep-serving semantics) catches
+        them."""
+        if self._manager is None:
+            raise RuntimeError("no checkpoint_dir: nothing to reload from")
+        with self._reload_lock:     # one reload at a time
+            if step is None:
+                step = self._manager.latest_step()
+            if step is None or int(step) == self.step:
+                return False
+            _FP_RELOAD.fire(crash=_reload_crash)
+            fresh = self._manager.restore(step=int(step),
+                                          sharding=self._sharding)
+            with self._params_lock:
+                self._params = fresh
+                self.step = int(step)
+            _M_STEP.set(self.step)
+            _M_HOT_SWAPS.inc()
+            log.info("serving: hot-swapped checkpoint step %d from %s",
+                     self.step, self.checkpoint_dir)
+            return True
+
+    def _poll_loop(self) -> None:
+        while not self._stop.wait(self._reload_poll):
+            try:
+                self.reload()
+            except Exception:   # noqa: BLE001 — old params keep serving
+                log.warning(
+                    "serving: hot-reload failed; previous step %d keeps "
+                    "serving (will retry in %.1fs)", self.step,
+                    self._reload_poll, exc_info=True)
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def close(self, timeout: float = 5.0) -> None:
+        """Idempotent: stop the reload poller and the batcher thread."""
+        self._stop.set()
+        poller, self._poller = self._poller, None
+        if poller is not None:
+            poller.join(timeout=timeout)
+        self._batcher.stop(timeout=timeout)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+def wait_for_step(directory: str, min_step: int = 0,
+                  timeout: float = 60.0) -> int:
+    """Serving-side startup helper: block until ``directory`` holds a
+    committed step >= ``min_step`` (training may still be warming up)."""
+    from ..checkpointing import latest_step
+    deadline = time.monotonic() + timeout
+    while True:
+        step = latest_step(directory)
+        if step is not None and step >= min_step:
+            return step
+        if time.monotonic() > deadline:
+            raise TimeoutError(
+                f"no committed checkpoint step >= {min_step} under "
+                f"{directory!r} within {timeout}s")
+        time.sleep(0.2)

@@ -1,0 +1,157 @@
+"""The serving HTTP front-end: ``/v1/infer`` + ``/healthz``.
+
+Same stdlib idiom as the rendezvous KV server and the metrics endpoint,
+now through the shared :mod:`horovod_tpu._http` helper: a
+``ThreadingHTTPServer`` with daemon handler threads, quiet logging, and
+idempotent stop. Each connection's handler thread blocks inside
+``engine.infer()`` until its micro-batch completes — the threaded
+server is what lets N concurrent requests coalesce into one forward.
+
+Admission control shows up at the wire as status codes:
+
+* ``200`` — inference served;
+* ``429`` — the request's deadline expired before its micro-batch
+  dispatched (client should slow down / shed load);
+* ``503`` — the bounded queue is full (back off and retry);
+* ``400`` — malformed request (not JSON, bad shapes);
+* ``500`` — the forward itself failed (includes injected
+  ``serving.forward`` faults; the next request gets a fresh batch).
+
+Every response increments ``hvd_tpu_serving_requests_total{code}``.
+
+Wire format (JSON): request ``{"inputs": [[...], ...]}`` (rows of the
+model's input; optional ``"deadline_ms"``), response
+``{"outputs": [...], "step": N}``.
+"""
+
+import json
+import logging
+from typing import Optional
+
+import numpy as np
+
+from .. import _http
+from .. import config as _config
+from .. import metrics as _metrics
+from .batcher import DeadlineExceededError, QueueFullError
+from .engine import InferenceEngine
+
+log = logging.getLogger("horovod_tpu.serving")
+
+_M_REQUESTS = _metrics.counter(
+    "hvd_tpu_serving_requests_total",
+    "Inference HTTP requests by response code: 200 served, 429 deadline "
+    "expired, 503 queue full (admission control), 400 malformed, "
+    "500 forward failure.",
+    labels=("code",))
+
+
+class _ServingHandler(_http.QuietHandler):
+    def _respond(self, code: int, doc: dict) -> None:
+        body = json.dumps(doc).encode("utf-8")
+        _M_REQUESTS.labels(code=str(code)).inc()
+        try:
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+        except OSError:
+            # client gave up while we were batching; nothing to serve
+            self.close_connection = True
+
+    def do_GET(self):  # noqa: N802 — BaseHTTPRequestHandler contract
+        engine: InferenceEngine = self.server.engine
+        if self.path.split("?", 1)[0] != "/healthz":
+            self._respond(404, {"error": "not found"})
+            return
+        self._respond(200, {
+            "status": "serving",
+            "step": engine.step,
+            "queue_depth": engine.queue_depth,
+        })
+
+    def do_POST(self):  # noqa: N802
+        engine: InferenceEngine = self.server.engine
+        if self.path.split("?", 1)[0] != "/v1/infer":
+            self._respond(404, {"error": "not found"})
+            return
+        try:
+            length = int(self.headers.get("Content-Length", 0))
+            doc = json.loads(self.rfile.read(length))
+            x = np.asarray(doc["inputs"], dtype=np.float32)
+        except (ValueError, KeyError, TypeError) as e:
+            self._respond(400, {"error": f"bad request: {e}"})
+            return
+        try:
+            out, step = engine.infer_with_step(
+                x, deadline_ms=doc.get("deadline_ms"))
+        except QueueFullError as e:
+            self._respond(503, {"error": str(e)})
+            return
+        except DeadlineExceededError as e:
+            self._respond(429, {"error": str(e)})
+            return
+        except ValueError as e:         # oversized request, bad rank
+            self._respond(400, {"error": str(e)})
+            return
+        except Exception as e:  # noqa: BLE001 — forward failure -> 500
+            log.warning("serving: forward failed for one batch: %s", e)
+            self._respond(500, {"error": str(e)})
+            return
+        # step comes back with the batch result: it names the checkpoint
+        # that PRODUCED these outputs, even if a hot-swap landed since
+        self._respond(200, {"outputs": np.asarray(out).tolist(),
+                            "step": step})
+
+
+class InferenceServer:
+    """Threaded HTTP front-end over one :class:`InferenceEngine`.
+
+    ``port`` defaults to ``HVD_TPU_SERVING_PORT`` (0 = ephemeral; read
+    the bound port back from :attr:`port`). ``start()``/``stop()`` are
+    idempotent; stopping the server does not close the engine (it may
+    serve in-process callers too) — use :meth:`close` for both.
+    """
+
+    def __init__(self, engine: InferenceEngine, port: Optional[int] = None,
+                 addr: str = "0.0.0.0", verbose: bool = False):
+        self.engine = engine
+        self._requested_port = int(
+            _config.live_config().get(_config.SERVING_PORT)
+            if port is None else port)
+        self._addr = addr
+        self._verbose = verbose
+        self._httpd = None
+
+    @property
+    def port(self) -> int:
+        if self._httpd is None:
+            raise RuntimeError("InferenceServer not started")
+        return self._httpd.server_address[1]
+
+    def start(self) -> int:
+        if self._httpd is None:
+            self._httpd = _http.start_server(
+                _ServingHandler, port=self._requested_port,
+                addr=self._addr, name="hvd-tpu-serving-http",
+                verbose=self._verbose)
+            self._httpd.engine = self.engine
+            log.info("serving: HTTP front-end on %s:%d (step %d)",
+                     self._addr, self.port, self.engine.step)
+        return self.port
+
+    def stop(self) -> None:
+        httpd, self._httpd = self._httpd, None
+        _http.stop_server(httpd)
+
+    def close(self) -> None:
+        self.stop()
+        self.engine.close()
+
+    def __enter__(self):
+        self.start()
+        return self
+
+    def __exit__(self, *exc):
+        self.close()

@@ -135,6 +135,22 @@ def test_checkpoint_suite_is_seeded_and_exclusive():
         os.path.join(root, "tests", "test_checkpointing.py"))
 
 
+def test_serving_suite_is_seeded_and_exclusive():
+    """The inference-serving suite (micro-batching, admission control,
+    hot-reload, forward/reload chaos drills) runs seeded as its own CI
+    suite; the generic unit and chaos suites must not run the file
+    twice."""
+    by_name = {name: cmd for name, cmd, _t in COMMON_SUITES}
+    assert "serving" in by_name
+    cmd = by_name["serving"]
+    assert "HVD_TPU_FAULT_SEED=" in cmd
+    assert "tests/test_serving.py" in cmd
+    assert "--ignore=tests/test_serving.py" in by_name["unit"]
+    assert "--ignore=tests/test_serving.py" in by_name["chaos"]
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    assert os.path.exists(os.path.join(root, "tests", "test_serving.py"))
+
+
 def test_check_knobs_lint_is_clean():
     """The knob lint must pass on the tree as committed: every HVD_TPU_*
     env var read in the package is registered in config.py and documented

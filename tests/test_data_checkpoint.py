@@ -206,8 +206,80 @@ class TestPrefetchLifecycle:
         with pytest.raises(StopIteration):
             next(it)
 
+    def test_concurrent_close_races_blocked_producer(self):
+        """close() called concurrently from several threads while the
+        producer is blocked on a full queue: every close returns, the
+        worker exits, nothing deadlocks, and the iterator stays
+        terminal."""
+        producing = threading.Event()
+
+        def src():
+            for i in range(1000):
+                producing.set()
+                yield np.zeros(1)
+
+        it = hdata.PrefetchIterator(src(), buffer_size=1, device_put=False)
+        assert producing.wait(5)
+        time.sleep(0.05)        # let the producer block in its bounded put
+        closers = [threading.Thread(target=it.close) for _ in range(4)]
+        for t in closers:
+            t.start()
+        for t in closers:
+            t.join(timeout=10)
+        assert all(not t.is_alive() for t in closers)   # no wedged close
+        assert not it._thread.is_alive()
+        with pytest.raises(StopIteration):
+            next(it)
+        it.close()              # idempotent after the race
+
     def test_context_manager(self):
         with hdata.PrefetchIterator(iter([np.zeros(1)] * 5),
                                     device_put=False) as it:
             next(it)
         assert not it._thread.is_alive()
+
+    # -- pad_remainder / pad_to_size (shared with the serving batcher) ------
+
+    def test_pad_to_size_pads_and_masks(self):
+        x = np.arange(6, dtype=np.float32).reshape(3, 2)
+        (px,), mask = hdata.pad_to_size((x,), 5)
+        assert px.shape == (5, 2) and mask.shape == (5,)
+        np.testing.assert_array_equal(px[:3], x)
+        np.testing.assert_array_equal(px[3:], 0)
+        np.testing.assert_array_equal(mask, [1, 1, 1, 0, 0])
+        # already-full input passes through unchanged
+        same, mask2 = hdata.pad_to_size(x, 3)
+        np.testing.assert_array_equal(same, x)
+        assert mask2.all()
+        with pytest.raises(ValueError):
+            hdata.pad_to_size(x, 2)
+
+    def test_batches_pad_remainder_keeps_tail_with_static_shapes(self):
+        x = np.arange(23, dtype=np.float32)
+        y = np.arange(23, dtype=np.float32) * 2
+        out = list(hdata.batches((x, y), 5, shuffle=False,
+                                 pad_remainder=True))
+        assert len(out) == 5            # the tail batch is kept
+        for bx, by, mask in out:        # every batch: arrays + mask
+            assert bx.shape == (5,) and by.shape == (5,)
+            assert mask.shape == (5,) and mask.dtype == bool
+        full_masks, tail_mask = [m for *_, m in out[:4]], out[-1][-1]
+        assert all(m.all() for m in full_masks)
+        np.testing.assert_array_equal(tail_mask, [1, 1, 1, 0, 0])
+        # rows survive exactly once; padding is zeros
+        np.testing.assert_array_equal(
+            np.concatenate([bx[m] for bx, _, m in out]), x)
+        np.testing.assert_array_equal(out[-1][0][~tail_mask], 0)
+
+    def test_batches_pad_remainder_drives_compiled_masked_step(self):
+        """The point of the mask: one compiled step shape serves every
+        batch, and masking reproduces the exact unpadded loss."""
+        x = np.arange(7, dtype=np.float32)
+
+        @jax.jit
+        def masked_sum(b, mask):
+            return jnp.sum(b * mask)
+
+        total = sum(float(masked_sum(b, m)) for b, m in
+                    hdata.batches(x, 4, shuffle=False, pad_remainder=True))
+        assert total == float(x.sum())

@@ -72,6 +72,23 @@ class TestEstimator:
         with pytest.raises(RuntimeError, match="fit"):
             est.predict(np.zeros((1, 8), np.float32))
 
+    def test_predict_varying_sizes_hits_bucket_cache(self, hvd_world):
+        """predict routes through the serving batcher's bucketed jit
+        cache: distinct input lengths land on a handful of power-of-two
+        bucket shapes (no per-length recompiles) and return the exact
+        unpadded eager values."""
+        from horovod_tpu.models import MLP
+        x, y = _toy_data()
+        est = hvd.Estimator(MLP(features=(16,), num_classes=4))
+        est.fit(x, y, epochs=1, batch_size=64)
+        for n in (1, 3, 5, 8, 13, 5, 3, 13):
+            preds = np.asarray(est.predict(x[:n]))
+            assert preds.shape == (n, 4)
+            np.testing.assert_allclose(
+                preds, np.asarray(est.model.apply(est.params, x[:n])),
+                atol=1e-6)
+        assert est._predict_cache.compiled_buckets == {1, 4, 8, 16}
+
 
 class TestSparkGate:
     def test_missing_pyspark_raises_clear_error(self):

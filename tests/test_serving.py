@@ -1,0 +1,718 @@
+"""Inference serving suite (ISSUE 5): dynamic micro-batching, admission
+control, checkpoint hot-reload, and the seeded serving chaos drills.
+
+Run as its own seeded CI suite (``serving`` in ci/gen_pipeline.py, owns
+this file exclusively). Everything here is in-process and fast; the
+e2e tests drive a live threaded HTTP server on an ephemeral port.
+"""
+
+import json
+import threading
+import time
+from urllib.error import HTTPError
+from urllib.request import Request, urlopen
+
+import numpy as np
+import pytest
+
+from horovod_tpu import faults as F
+from horovod_tpu import metrics as M
+from horovod_tpu import serving
+from horovod_tpu.serving.batcher import (BucketedForward,
+                                         DeadlineExceededError, MicroBatcher,
+                                         QueueFullError, bucket_for,
+                                         next_pow2, parse_buckets)
+
+SEED = 1234
+
+IN_DIM, OUT_DIM = 4, 2
+
+
+@pytest.fixture(autouse=True)
+def _reset_faults():
+    yield
+    F.configure("", seed=0)
+
+
+def _apply(params, x):
+    return x @ params["w"] + params["b"]
+
+
+def _params(scale: float):
+    """Row-wise linear model: ones(IN_DIM) @ w -> full(OUT_DIM, 4*scale),
+    so the serving checkpoint version is readable off any output."""
+    return {"w": np.full((IN_DIM, OUT_DIM), scale, np.float32),
+            "b": np.zeros(OUT_DIM, np.float32)}
+
+
+def _rows(n: int, value: float = 1.0):
+    return np.full((n, IN_DIM), value, np.float32)
+
+
+def _delta(before, key):
+    return M.snapshot().get(key, 0) - before.get(key, 0)
+
+
+# ---------------------------------------------------------------------------
+# buckets + per-bucket jit cache
+# ---------------------------------------------------------------------------
+
+class TestBuckets:
+    def test_default_buckets_are_pow2_up_to_max(self):
+        assert parse_buckets("", 8) == (1, 2, 4, 8)
+        assert parse_buckets("", 12) == (1, 2, 4, 8, 12)
+        assert parse_buckets("", 1) == (1,)
+
+    def test_explicit_spec_keeps_max_as_bucket(self):
+        assert parse_buckets("3,6", 8) == (3, 6, 8)
+        assert parse_buckets("2, 4", 4) == (2, 4)
+
+    def test_bucket_beyond_max_batch_is_a_loud_misconfiguration(self):
+        # silently dropping the 64 would turn the operator's explicit
+        # capacity into surprise per-request rejections
+        with pytest.raises(ValueError, match="SERVING_MAX_BATCH"):
+            parse_buckets("2,64", 8)
+
+    @pytest.mark.parametrize("bad", ["x", "0", "-2,4"])
+    def test_bad_specs_raise(self, bad):
+        with pytest.raises(ValueError):
+            parse_buckets(bad, 8)
+
+    def test_bucket_for(self):
+        assert bucket_for(3, (1, 2, 4, 8)) == 4
+        assert bucket_for(8, (1, 2, 4, 8)) == 8
+        with pytest.raises(ValueError):
+            bucket_for(9, (1, 2, 4, 8))
+
+    def test_next_pow2(self):
+        assert [next_pow2(n) for n in (1, 2, 3, 5, 8, 9)] == \
+            [1, 2, 4, 8, 8, 16]
+
+
+class TestBucketedForward:
+    def test_apply_padded_matches_direct_apply(self):
+        fwd = BucketedForward(_apply, buckets=(1, 2, 4, 8))
+        p = _params(1.0)
+        for n in (1, 3, 5, 8):
+            out = np.asarray(fwd.apply_padded(p, _rows(n)))
+            np.testing.assert_allclose(out, _apply(p, _rows(n)), atol=1e-6)
+            assert out.shape == (n, OUT_DIM)   # unpadded return
+
+    def test_varying_sizes_share_buckets(self):
+        """Repeated calls of distinct lengths land on a handful of
+        bucket shapes — the Estimator.predict recompile fix."""
+        fwd = BucketedForward(_apply)     # dynamic pow2 buckets
+        p = _params(1.0)
+        for n in (1, 2, 3, 4, 5, 6, 7, 8, 5, 3, 7):
+            fwd.apply_padded(p, _rows(n))
+        assert fwd.compiled_buckets == {1, 2, 4, 8}
+
+    def test_warmup_compiles_every_bucket(self):
+        fwd = BucketedForward(_apply, buckets=(1, 2, 4))
+        fwd.warmup(_params(1.0), (IN_DIM,))
+        assert fwd.compiled_buckets == {1, 2, 4}
+
+
+# ---------------------------------------------------------------------------
+# micro-batcher: coalescing, admission control, deadlines
+# ---------------------------------------------------------------------------
+
+class TestMicroBatcher:
+    def _batcher(self, forward=None, **kw):
+        kw.setdefault("max_batch", 8)
+        kw.setdefault("timeout_ms", 500.0)
+        kw.setdefault("queue_depth", 16)
+        kw.setdefault("default_deadline_ms", 0)      # no deadlines
+        p = _params(1.0)
+        if forward is None:
+            def forward(x, n):
+                return _apply(p, x)
+        return MicroBatcher(forward, **kw)
+
+    def test_single_request_roundtrip(self):
+        b = self._batcher()
+        try:
+            out = b.infer(_rows(3), timeout=10)
+            np.testing.assert_allclose(out, np.full((3, OUT_DIM), 4.0),
+                                       atol=1e-6)
+        finally:
+            b.stop()
+
+    def test_concurrent_requests_coalesce(self):
+        """4 one-row requests submitted together form ONE micro-batch
+        (rows == max_batch dispatches without waiting out the window),
+        and the batch-size histogram records it."""
+        sizes = []
+        p = _params(1.0)
+
+        def forward(x, n):
+            sizes.append((int(x.shape[0]), n))
+            return _apply(p, x)
+
+        before = M.snapshot()
+        b = self._batcher(forward, max_batch=4, timeout_ms=2000.0)
+        try:
+            reqs = [b.submit(_rows(1, value=i)) for i in range(4)]
+            outs = [np.asarray(b.result(r, timeout=10)) for r in reqs]
+        finally:
+            b.stop()
+        assert sizes == [(4, 4)]      # one padded batch, 4 live rows
+        for i, out in enumerate(outs):    # results landed per-request
+            np.testing.assert_allclose(out, np.full((1, OUT_DIM), 4.0 * i),
+                                       atol=1e-6)
+        snap = M.snapshot()
+        hist = snap["hvd_tpu_serving_batch_size"]
+        prev = before.get("hvd_tpu_serving_batch_size",
+                          {"count": 0, "sum": 0})
+        assert hist["count"] == prev["count"] + 1
+        assert hist["sum"] == prev["sum"] + 4
+
+    def test_window_dispatches_partial_batch(self):
+        b = self._batcher(max_batch=8, timeout_ms=50.0)
+        try:
+            r1 = b.submit(_rows(1))
+            r2 = b.submit(_rows(2))
+            t0 = time.monotonic()
+            b.result(r1, timeout=10)
+            b.result(r2, timeout=10)
+            # dispatched by the window, not a full bucket
+            assert time.monotonic() - t0 < 5.0
+        finally:
+            b.stop()
+
+    def test_ragged_batch_pads_to_bucket(self):
+        sizes = []
+        p = _params(1.0)
+
+        def forward(x, n):
+            sizes.append((int(x.shape[0]), n))
+            return _apply(p, x)
+
+        b = self._batcher(forward, max_batch=8, timeout_ms=100.0)
+        try:
+            reqs = [b.submit(_rows(1)), b.submit(_rows(2))]
+            for r in reqs:
+                b.result(r, timeout=10)
+        finally:
+            b.stop()
+        assert sizes == [(4, 3)]      # 3 live rows padded to bucket 4
+
+    def test_queue_full_rejects_fast(self):
+        gate = threading.Event()
+        p = _params(1.0)
+
+        def slow_forward(x, n):
+            gate.wait(10)
+            return _apply(p, x)
+
+        before = M.snapshot()
+        b = self._batcher(slow_forward, max_batch=1, queue_depth=2)
+        try:
+            first = b.submit(_rows(1))
+            deadline = time.monotonic() + 5
+            admitted = []
+            rejected = 0
+            while time.monotonic() < deadline and rejected == 0:
+                try:
+                    admitted.append(b.submit(_rows(1)))
+                except QueueFullError:
+                    rejected += 1
+            assert rejected == 1      # bounded queue pushed back
+            gate.set()
+            b.result(first, timeout=10)
+            for r in admitted:
+                b.result(r, timeout=10)
+        finally:
+            gate.set()
+            b.stop()
+        assert _delta(before,
+                      'hvd_tpu_serving_rejected_total{reason="queue_full"}') \
+            == 1
+
+    def test_deadline_expiry_rejects_without_forward(self):
+        gate = threading.Event()
+        p = _params(1.0)
+        forwarded = []
+
+        def slow_forward(x, n):
+            forwarded.append(n)
+            gate.wait(10)
+            return _apply(p, x)
+
+        before = M.snapshot()
+        b = self._batcher(slow_forward, max_batch=1, queue_depth=8)
+        try:
+            first = b.submit(_rows(1))          # occupies the forward
+            while not forwarded:                # until it's truly in-flight
+                time.sleep(0.005)
+            late = b.submit(_rows(1), deadline_ms=50)
+            time.sleep(0.1)                     # let the deadline lapse
+            gate.set()
+            b.result(first, timeout=10)
+            with pytest.raises(DeadlineExceededError):
+                b.result(late, timeout=10)
+        finally:
+            gate.set()
+            b.stop()
+        assert forwarded == [1]                 # expired request never ran
+        assert _delta(before,
+                      'hvd_tpu_serving_rejected_total{reason="deadline"}') \
+            == 1
+
+    def test_oversized_request_rejected(self):
+        b = self._batcher(max_batch=4)
+        try:
+            with pytest.raises(ValueError, match="SERVING_MAX_BATCH"):
+                b.submit(_rows(5))
+        finally:
+            b.stop()
+
+    def test_mismatched_row_shape_rejected_at_admission(self):
+        """A malformed-shape request is the SENDER's 400 — rejected at
+        submit, never coalesced into (and poisoning) an innocent
+        micro-batch."""
+        b = self._batcher(max_batch=8, timeout_ms=200.0)
+        try:
+            r1 = b.submit(_rows(1))                      # learns (IN_DIM,)
+            with pytest.raises(ValueError, match="row shape"):
+                b.submit(np.ones((1, IN_DIM + 3), np.float32))
+            # the innocent request still completes cleanly
+            np.testing.assert_allclose(
+                np.asarray(b.result(r1, timeout=10)),
+                np.full((1, OUT_DIM), 4.0), atol=1e-6)
+        finally:
+            b.stop()
+
+    def test_example_seeds_row_shape_before_first_request(self):
+        eng = serving.InferenceEngine(
+            _apply, params=_params(1.0), warmup=False,
+            reload_poll_seconds=0,
+            example=np.zeros(IN_DIM, np.float32))
+        try:
+            with pytest.raises(ValueError, match="row shape"):
+                eng.infer(np.ones((1, IN_DIM + 1), np.float32))
+        finally:
+            eng.close()
+
+    def test_infer_with_step_labels_producing_checkpoint(self, tmp_path):
+        from horovod_tpu import checkpointing
+        checkpointing.save(str(tmp_path), 7, _params(1.0))
+        with _engine(tmp_path) as eng:
+            out, step = eng.infer_with_step(_rows(2), timeout=10)
+            assert step == 7
+            np.testing.assert_allclose(np.asarray(out),
+                                       np.full((2, OUT_DIM), 4.0),
+                                       atol=1e-6)
+
+    def test_stop_is_idempotent_and_fails_queued(self):
+        b = self._batcher()
+        b.stop()
+        b.stop()
+        with pytest.raises(RuntimeError):
+            b.submit(_rows(1))
+
+    def test_stop_never_blocks_on_full_queue_with_wedged_forward(self):
+        """Shutdown under the worst case — queue at capacity, batcher
+        thread stuck in a hung forward — must return within stop()'s
+        timeout and fail every queued request, not hang close()."""
+        gate = threading.Event()
+        p = _params(1.0)
+
+        def wedged_forward(x, n):
+            gate.wait(30)
+            return _apply(p, x)
+
+        b = self._batcher(wedged_forward, max_batch=1, queue_depth=2)
+        try:
+            first = b.submit(_rows(1))          # occupies the forward
+            queued = []
+            deadline = time.monotonic() + 5
+            while len(queued) < 2 and time.monotonic() < deadline:
+                try:
+                    queued.append(b.submit(_rows(1)))
+                except QueueFullError:
+                    break                        # queue truly full
+            t0 = time.monotonic()
+            b.stop(timeout=2.0)
+            assert time.monotonic() - t0 < 4.0   # returned, no hang
+            for r in queued:
+                with pytest.raises(RuntimeError, match="stopped"):
+                    b.result(r, timeout=5)
+        finally:
+            gate.set()                           # release the thread
+
+    def test_negative_deadline_is_shed_at_admission(self):
+        before = M.snapshot()
+        b = self._batcher()
+        try:
+            with pytest.raises(DeadlineExceededError, match="negative"):
+                b.submit(_rows(1), deadline_ms=-5)
+        finally:
+            b.stop()
+        assert _delta(before,
+                      'hvd_tpu_serving_rejected_total{reason="deadline"}') \
+            == 1
+
+
+# ---------------------------------------------------------------------------
+# engine: restore onto serving mesh, hot-reload, chaos drills
+# ---------------------------------------------------------------------------
+
+def _engine(tmp_path=None, params=None, **kw):
+    kw.setdefault("max_batch", 8)
+    kw.setdefault("batch_timeout_ms", 5.0)
+    kw.setdefault("deadline_ms", 0)
+    kw.setdefault("reload_poll_seconds", 0)
+    kw.setdefault("warmup", False)
+    return serving.InferenceEngine(
+        _apply, checkpoint_dir=str(tmp_path) if tmp_path else None,
+        params=params, **kw)
+
+
+class TestInferenceEngine:
+    def test_params_xor_checkpoint_dir(self):
+        with pytest.raises(ValueError):
+            serving.InferenceEngine(_apply)
+        with pytest.raises(ValueError):
+            serving.InferenceEngine(_apply, checkpoint_dir="/x",
+                                    params=_params(1.0))
+
+    def test_direct_params_infer(self):
+        with _engine(params=_params(1.0)) as eng:
+            out = np.asarray(eng.infer(_rows(3), timeout=10))
+            np.testing.assert_allclose(out, np.full((3, OUT_DIM), 4.0),
+                                       atol=1e-6)
+            assert eng.step == -1
+
+    def test_restores_latest_committed_step(self, tmp_path):
+        from horovod_tpu import checkpointing
+        checkpointing.save(str(tmp_path), 1, _params(1.0))
+        checkpointing.save(str(tmp_path), 2, _params(2.0))
+        with _engine(tmp_path) as eng:
+            assert eng.step == 2
+            out = np.asarray(eng.infer(_rows(1), timeout=10))
+            np.testing.assert_allclose(out, np.full((1, OUT_DIM), 8.0),
+                                       atol=1e-6)
+        assert M.snapshot()["hvd_tpu_serving_checkpoint_step"] == 2
+
+    def test_empty_dir_raises_up_front(self, tmp_path):
+        with pytest.raises(FileNotFoundError):
+            _engine(tmp_path)
+
+    def test_warmup_from_example(self):
+        eng = serving.InferenceEngine(
+            _apply, params=_params(1.0), buckets=(1, 2, 4), max_batch=4,
+            warmup=True, example=np.zeros(IN_DIM, np.float32),
+            reload_poll_seconds=0)
+        try:
+            assert eng._bucketed.compiled_buckets == {1, 2, 4}
+        finally:
+            eng.close()
+
+    def test_explicit_reload_swaps_and_counts(self, tmp_path):
+        from horovod_tpu import checkpointing
+        checkpointing.save(str(tmp_path), 1, _params(1.0))
+        before = M.snapshot()
+        with _engine(tmp_path) as eng:
+            assert eng.reload() is False          # nothing newer
+            checkpointing.save(str(tmp_path), 5, _params(2.0))
+            assert eng.reload() is True
+            assert eng.step == 5
+            out = np.asarray(eng.infer(_rows(1), timeout=10))
+            np.testing.assert_allclose(out, np.full((1, OUT_DIM), 8.0),
+                                       atol=1e-6)
+        assert _delta(before, "hvd_tpu_serving_hot_swaps_total") == 1
+
+    def test_background_poll_hot_reloads_without_dropping_requests(
+            self, tmp_path):
+        """The zero-downtime contract: a client hammering the engine
+        across a hot-reload sees only clean responses, each fully from
+        one checkpoint (4.0-outputs or 8.0-outputs, never a mix)."""
+        from horovod_tpu import checkpointing
+        checkpointing.save(str(tmp_path), 1, _params(1.0))
+        results, errors = [], []
+        stop = threading.Event()
+
+        with _engine(tmp_path, reload_poll_seconds=0.05) as eng:
+            def client():
+                while not stop.is_set():
+                    try:
+                        out = np.asarray(eng.infer(_rows(2), timeout=10))
+                    except Exception as e:  # noqa: BLE001
+                        errors.append(e)
+                        return
+                    vals = set(np.unique(out).tolist())
+                    results.append(vals)
+                    time.sleep(0.002)
+
+            t = threading.Thread(target=client)
+            t.start()
+            try:
+                time.sleep(0.1)
+                checkpointing.save(str(tmp_path), 2, _params(2.0))
+                deadline = time.monotonic() + 10
+                while eng.step != 2 and time.monotonic() < deadline:
+                    time.sleep(0.02)
+                assert eng.step == 2, "hot-reload never happened"
+                time.sleep(0.1)
+            finally:
+                stop.set()
+                t.join(timeout=10)
+        assert not errors, errors
+        assert results
+        # every response came wholly from one version, and traffic
+        # observed both sides of the swap
+        assert all(vals in ({4.0}, {8.0}) for vals in results), results
+        assert results[-1] == {8.0}
+        assert {4.0} in results
+
+    def test_reload_crash_drill_keeps_old_params_serving(self, tmp_path):
+        """Seeded drill: a crash injected mid-hot-reload must leave the
+        old checkpoint serving; the next (fault-consumed) attempt
+        swaps."""
+        from horovod_tpu import checkpointing
+        checkpointing.save(str(tmp_path), 1, _params(1.0))
+        F.configure("serving.reload:crash:once", seed=SEED)
+        with _engine(tmp_path) as eng:
+            checkpointing.save(str(tmp_path), 2, _params(2.0))
+            with pytest.raises(serving.ReloadCrashed):
+                eng.reload()
+            assert eng.step == 1                  # swap never happened
+            out = np.asarray(eng.infer(_rows(1), timeout=10))
+            np.testing.assert_allclose(out, np.full((1, OUT_DIM), 4.0),
+                                       atol=1e-6)
+            assert eng.reload() is True           # 'once' consumed
+            assert eng.step == 2
+
+    def test_poll_loop_survives_reload_crash(self, tmp_path):
+        """Same drill through the background poller: the crash is
+        absorbed (old params keep serving) and the next poll completes
+        the swap — serving never dies."""
+        from horovod_tpu import checkpointing
+        checkpointing.save(str(tmp_path), 1, _params(1.0))
+        F.configure("serving.reload:crash:once", seed=SEED)
+        with _engine(tmp_path, reload_poll_seconds=0.05) as eng:
+            checkpointing.save(str(tmp_path), 2, _params(2.0))
+            deadline = time.monotonic() + 10
+            while eng.step != 2 and time.monotonic() < deadline:
+                out = np.asarray(eng.infer(_rows(1), timeout=10))
+                assert float(out[0, 0]) in (4.0, 8.0)
+            assert eng.step == 2
+
+    def test_wait_for_step(self, tmp_path):
+        from horovod_tpu import checkpointing
+        with pytest.raises(TimeoutError):
+            serving.wait_for_step(str(tmp_path), timeout=0.3)
+        checkpointing.save(str(tmp_path), 3, _params(1.0))
+        assert serving.wait_for_step(str(tmp_path), timeout=5) == 3
+
+
+# ---------------------------------------------------------------------------
+# seeded determinism of the serving fault sites
+# ---------------------------------------------------------------------------
+
+class TestServingChaosDeterminism:
+    def test_seeded_site_pattern_is_reproducible(self):
+        pats = []
+        for _ in range(3):
+            F.configure("serving.forward:error:rate=0.4", seed=SEED)
+            fp = F.FaultPoint("serving.forward")
+            pat = []
+            for _ in range(50):
+                try:
+                    fp.fire()
+                    pat.append(0)
+                except F.InjectedFault:
+                    pat.append(1)
+            pats.append(pat)
+        assert pats[0] == pats[1] == pats[2]
+        assert 5 < sum(pats[0]) < 40
+
+
+# ---------------------------------------------------------------------------
+# e2e: live HTTP front-end
+# ---------------------------------------------------------------------------
+
+def _post(port, inputs, deadline_ms=None, timeout=15):
+    doc = {"inputs": inputs}
+    if deadline_ms is not None:
+        doc["deadline_ms"] = deadline_ms
+    req = Request(f"http://127.0.0.1:{port}/v1/infer",
+                  data=json.dumps(doc).encode(), method="POST",
+                  headers={"Content-Type": "application/json"})
+    try:
+        with urlopen(req, timeout=timeout) as resp:
+            return resp.status, json.loads(resp.read())
+    except HTTPError as e:
+        return e.code, json.loads(e.read() or b"{}")
+
+
+class TestHTTPServing:
+    def _serve(self, engine):
+        srv = serving.InferenceServer(engine, port=0, addr="127.0.0.1")
+        srv.start()
+        return srv
+
+    def test_infer_and_healthz(self):
+        srv = self._serve(_engine(params=_params(1.0)))
+        try:
+            code, doc = _post(srv.port, _rows(2).tolist())
+            assert code == 200
+            np.testing.assert_allclose(np.asarray(doc["outputs"]),
+                                       np.full((2, OUT_DIM), 4.0), atol=1e-6)
+            assert doc["step"] == -1
+            with urlopen(f"http://127.0.0.1:{srv.port}/healthz",
+                         timeout=10) as resp:
+                health = json.loads(resp.read())
+            assert resp.status == 200
+            assert health["status"] == "serving"
+            assert health["queue_depth"] == 0
+        finally:
+            srv.close()
+
+    def test_bad_request_and_unknown_path(self):
+        before = M.snapshot()
+        srv = self._serve(_engine(params=_params(1.0)))
+        try:
+            req = Request(f"http://127.0.0.1:{srv.port}/v1/infer",
+                          data=b"not json", method="POST")
+            with pytest.raises(HTTPError) as e:
+                urlopen(req, timeout=10)
+            assert e.value.code == 400
+            with pytest.raises(HTTPError) as e:
+                urlopen(f"http://127.0.0.1:{srv.port}/nope", timeout=10)
+            assert e.value.code == 404
+        finally:
+            srv.close()
+        assert _delta(before,
+                      'hvd_tpu_serving_requests_total{code="400"}') == 1
+
+    def test_concurrent_clients_observe_coalesced_batches(self):
+        """The e2e acceptance scenario: N concurrent HTTP clients, the
+        batch-size histogram proves their requests shared forwards."""
+        before = M.snapshot()
+        srv = self._serve(_engine(params=_params(1.0), max_batch=8,
+                                  batch_timeout_ms=300.0))
+        n_clients = 6
+        barrier = threading.Barrier(n_clients)
+        results = [None] * n_clients
+
+        def client(i):
+            barrier.wait(timeout=10)
+            results[i] = _post(srv.port, _rows(1, value=i).tolist())
+
+        try:
+            threads = [threading.Thread(target=client, args=(i,))
+                       for i in range(n_clients)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=30)
+        finally:
+            srv.close()
+        for i, (code, doc) in enumerate(results):
+            assert code == 200, results[i]
+            np.testing.assert_allclose(
+                np.asarray(doc["outputs"]),
+                np.full((1, OUT_DIM), 4.0 * i), atol=1e-6)
+        hist = M.snapshot()["hvd_tpu_serving_batch_size"]
+        prev = before.get("hvd_tpu_serving_batch_size",
+                          {"count": 0, "sum": 0})
+        batches = hist["count"] - prev["count"]
+        rows = hist["sum"] - prev["sum"]
+        assert rows == n_clients
+        assert batches < n_clients      # at least one multi-request batch
+
+    def test_overload_degrades_to_fast_429_503(self):
+        """Admission-control acceptance: under a slowed forward with a
+        tiny queue, overload answers 503 (queue full) and 429 (deadline)
+        within the deadline budget instead of queuing unboundedly."""
+        before = M.snapshot()
+        F.configure("serving.forward:delay=0.3", seed=SEED)
+        srv = self._serve(_engine(params=_params(1.0), max_batch=1,
+                                  queue_depth=2))
+        n_clients = 8
+        barrier = threading.Barrier(n_clients)
+        codes = [None] * n_clients
+
+        def client(i):
+            barrier.wait(timeout=10)
+            codes[i], _ = _post(srv.port, _rows(1).tolist(),
+                                deadline_ms=100)
+
+        try:
+            threads = [threading.Thread(target=client, args=(i,))
+                       for i in range(n_clients)]
+            t0 = time.monotonic()
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=30)
+            elapsed = time.monotonic() - t0
+        finally:
+            srv.close()
+            F.configure("", seed=0)
+        assert sorted(set(codes)) and all(c in (200, 429, 503)
+                                          for c in codes), codes
+        assert codes.count(200) >= 1            # service kept serving
+        assert 503 in codes                     # queue-full backpressure
+        assert 429 in codes                     # deadline expiry
+        # fast degradation: nowhere near n_clients * forward_delay
+        assert elapsed < 5.0
+        snap = M.snapshot()
+        total = sum(
+            _delta(before, f'hvd_tpu_serving_requests_total{{code="{c}"}}')
+            for c in (200, 429, 503))
+        assert total == n_clients
+        assert _delta(before, 'hvd_tpu_serving_rejected_total'
+                              '{reason="queue_full"}') >= 1
+        assert _delta(before, 'hvd_tpu_serving_rejected_total'
+                              '{reason="deadline"}') >= 1
+
+    def test_seeded_forward_error_drill_500_exactly_once(self):
+        """The ISSUE acceptance drill: serving.forward:error:once makes
+        exactly one request fail 500; the very next request is served —
+        the batcher recovered, nothing wedged."""
+        before = M.snapshot()
+        F.configure("serving.forward:error:once", seed=SEED)
+        srv = self._serve(_engine(params=_params(1.0)))
+        try:
+            code1, doc1 = _post(srv.port, _rows(1).tolist())
+            code2, doc2 = _post(srv.port, _rows(1).tolist())
+        finally:
+            srv.close()
+            F.configure("", seed=0)
+        assert code1 == 500 and "injected fault" in doc1["error"]
+        assert code2 == 200
+        assert _delta(before,
+                      'hvd_tpu_serving_requests_total{code="500"}') == 1
+        assert _delta(before,
+                      'hvd_tpu_serving_requests_total{code="200"}') == 1
+
+    def test_hot_reload_mid_traffic_over_http(self, tmp_path):
+        """e2e hot-reload: a client looping against the live server
+        across a checkpoint swap sees zero failures and the outputs
+        flip from the old step's values to the new step's."""
+        from horovod_tpu import checkpointing
+        checkpointing.save(str(tmp_path), 1, _params(1.0))
+        srv = self._serve(_engine(tmp_path, reload_poll_seconds=0.05))
+        seen = []
+        try:
+            checkpointing.save(str(tmp_path), 2, _params(2.0))
+            deadline = time.monotonic() + 15
+            while time.monotonic() < deadline:
+                code, doc = _post(srv.port, _rows(1).tolist())
+                assert code == 200, doc
+                val = float(np.asarray(doc["outputs"])[0, 0])
+                assert val in (4.0, 8.0)
+                seen.append((doc["step"], val))
+                if doc["step"] == 2 and val == 8.0:
+                    break
+                time.sleep(0.01)
+        finally:
+            srv.close()
+        assert seen[-1] == (2, 8.0), seen[-5:]
+        # the step label rides back with the batch result, so it names
+        # the checkpoint that PRODUCED each response exactly — even
+        # across the swap instant
+        assert all(v == (4.0 if s == 1 else 8.0) for s, v in seen), seen
