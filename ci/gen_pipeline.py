@@ -23,6 +23,11 @@ COMPOSE_PATH = os.path.join(HERE, "docker-compose.test.yml")
 #: suites every service runs (path, parallelism-safe, timeout minutes)
 COMMON_SUITES = [
     ("lint-knobs", "python tools/check_knobs.py", 5),
+    # the full concurrency-aware static-analysis suite (lock-discipline,
+    # lock-order, fault-site/metric contracts, jit-purity, knobs): zero
+    # unwaived findings and the waiver budget enforced on every service
+    # (docs/static_analysis.md)
+    ("lint-static", "python -m tools.analyze", 10),
     # chaos tests are excluded here because the chaos suite below is
     # their single owner — without the filter every fast chaos test
     # would run twice per service; the checkpoint and serving suites

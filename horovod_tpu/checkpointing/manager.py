@@ -45,6 +45,7 @@ import time
 import weakref
 from typing import Any, List, Optional
 
+from .. import _locks
 from .. import config as _config
 from .. import faults as _faults
 from .. import metrics as _metrics
@@ -182,7 +183,7 @@ class CheckpointManager:
         self._queue: "queue.Queue" = queue.Queue(maxsize=self.max_inflight)
         self._thread: Optional[threading.Thread] = None
         self._error: Optional[BaseException] = None
-        self._lock = threading.Lock()
+        self._lock = _locks.lock("checkpointing.CheckpointManager._lock")
         self._pending_steps: set = set()
         _MANAGERS.add(self)
 
@@ -305,11 +306,28 @@ class CheckpointManager:
     def close(self) -> None:
         """Drain and stop the writer thread (managers are reusable after
         close — the next async save restarts the writer)."""
-        thread = self._thread
+        # take the handle under the lock so close() can't race
+        # _ensure_writer replacing self._thread; the blocking put/join
+        # happen after the lock is released (the writer's finally block
+        # needs this lock to make progress, so a blocking put here while
+        # holding it could deadlock on a full queue)
+        with self._lock:
+            thread, self._thread = self._thread, None
         if thread is not None and thread.is_alive():
             self._queue.put(_STOP)
-            thread.join()
-        self._thread = None
+            while True:
+                thread.join(timeout=0.1)
+                if not thread.is_alive():
+                    break
+                # a save() racing this close() may have started a fresh
+                # writer that consumed our sentinel — re-send it so the
+                # thread we are joining is guaranteed to see one (a
+                # leftover sentinel merely stops a later writer early;
+                # _ensure_writer restarts it on the next async save)
+                try:
+                    self._queue.put_nowait(_STOP)
+                except queue.Full:
+                    pass
         self._raise_pending()
 
     # -- background writer ---------------------------------------------------

@@ -317,6 +317,14 @@ FAULT_SEED = _register(
     help="Seed for every probabilistic fault-injection decision. The same "
          "seed + spec + call sequence reproduces the same faults on every "
          "run and every process.")
+LOCK_CHECK = _register(
+    "LOCK_CHECK", False, _parse_bool,
+    help="Enable the runtime lock-order sentinel: locks created through "
+         "horovod_tpu/_locks.py record per-thread acquisition order and "
+         "raise LockOrderError on an ordering violation (potential "
+         "deadlock) or a self-deadlocking re-acquisition. Off by default "
+         "(plain locks, zero overhead); the test suites run with it on. "
+         "See docs/static_analysis.md.")
 RETRY_MAX_ATTEMPTS = _register(
     "RETRY_MAX_ATTEMPTS", 5, int,
     help="Total attempts (first call + retries) for transient host-plane "

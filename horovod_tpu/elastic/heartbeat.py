@@ -39,6 +39,7 @@ import threading
 import time
 from typing import Callable, Dict, Optional, Tuple
 
+from .. import _locks
 from .. import config as _config
 from .. import faults as _faults
 from .. import metrics as _metrics
@@ -144,7 +145,7 @@ class HeartbeatMonitor:
                 "the heartbeat interval; clamping to %.1fs",
                 self._timeout, floor)
             self._timeout = floor
-        self._lock = threading.Lock()
+        self._lock = _locks.lock("heartbeat.HeartbeatMonitor._lock")
         #: (host, slot) -> (last receipt monotonic, last reported rank)
         self._beats: Dict[Tuple[str, int], Tuple[float, str]] = {}
         self._stop = threading.Event()

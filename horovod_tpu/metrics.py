@@ -37,6 +37,7 @@ import json
 import threading
 from typing import Dict, Iterable, Optional, Sequence, Tuple
 
+from . import _locks
 from . import config as _config
 from ._native import get as _native_get
 
@@ -76,7 +77,7 @@ class _Cell:
         self._ready = False
         self._nat = None
         self._h = None
-        self._lock = threading.Lock()
+        self._lock = _locks.lock("metrics._Cell._lock")
         self._v = 0.0
 
     def _resolve(self) -> None:
@@ -185,7 +186,7 @@ class Histogram:
         self._ready = False
         self._nat = None
         self._h = None
-        self._lock = threading.Lock()
+        self._lock = _locks.lock("metrics.Histogram._lock")
         self._counts = [0] * (len(self._bounds) + 1)
         self._sum = 0.0
         self._count = 0
@@ -273,7 +274,7 @@ class Family:
         self._buckets = tuple(sorted(float(b) for b in buckets)) if buckets \
             else (DEFAULT_LATENCY_BUCKETS if kind == "histogram" else None)
         self._registry = registry
-        self._lock = threading.Lock()
+        self._lock = _locks.lock("metrics.Family._lock")
         self._children: Dict[Tuple[str, ...], object] = {}
         if not labelnames:
             self._children[()] = self._make_child()
@@ -341,7 +342,7 @@ class Registry:
 
     def __init__(self):
         self.enabled = True
-        self._lock = threading.Lock()
+        self._lock = _locks.lock("metrics.Registry._lock")
         self._families: Dict[str, Family] = {}
 
     def _register(self, name: str, help: str, kind: str,

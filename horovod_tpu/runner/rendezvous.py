@@ -34,6 +34,7 @@ from urllib.request import Request, urlopen
 from urllib.error import HTTPError, URLError
 
 from .. import _http
+from .. import _locks
 from .. import config as _config
 from .. import faults as _faults
 from .. import metrics as _metrics
@@ -167,7 +168,7 @@ class KVStoreServer:
                  journal_dir: Optional[str] = None,
                  snapshot_every: Optional[int] = None):
         self._data: Dict[Tuple[str, str], bytes] = {}
-        self._lock = threading.Lock()
+        self._lock = _locks.lock("rendezvous.KVStoreServer._lock")
         self._requested_port = port
         self._verbose = verbose
         self._httpd: Optional[_KVServer] = None
@@ -193,7 +194,7 @@ class KVStoreServer:
         self._replayed = 0
         self._last_port: Optional[int] = None
 
-        self._stop_lock = threading.Lock()
+        self._stop_lock = _locks.lock("rendezvous.KVStoreServer._stop_lock")
         self._stopping = False
         self._crashed = threading.Event()
         self._supervisor: Optional[threading.Thread] = None
@@ -223,8 +224,12 @@ class KVStoreServer:
     def start(self) -> int:
         # Socket is bound here, not in __init__, so constructing a server is
         # side-effect free and a failed run can retry the same fixed port.
-        self._stopping = False
-        self._crashed.clear()   # stop() sets it to wake the supervisor
+        with self._stop_lock:
+            # under the stop lock: a start() racing a concurrent stop()
+            # must not un-set the flag/wake-event between stop()'s two
+            # steps, or the supervisor would miss its exit signal
+            self._stopping = False
+            self._crashed.clear()   # stop() sets it to wake the supervisor
         self._restore_and_bump_epoch()
         port = self._requested_port
         persisted = self._persisted_port() if port == 0 else None
@@ -654,7 +659,7 @@ class KVStoreClient:
         self._timeout = timeout
         self._retry = retry or _retry.RetryPolicy.from_config()
         self.on_epoch_bump = on_epoch_bump
-        self._epoch_lock = threading.Lock()
+        self._epoch_lock = _locks.lock("rendezvous.KVStoreClient._epoch_lock")
         self._epoch_seen = 0
         self._in_bump = threading.local()
 

@@ -39,6 +39,7 @@ from typing import Callable, Optional, Sequence, Tuple
 
 import numpy as np
 
+from .. import _locks
 from .. import config as _config
 from .. import data as _data
 from .. import faults as _faults
@@ -148,7 +149,7 @@ class BucketedForward:
         import jax
         self._fn = jax.jit(fn)
         self._buckets = tuple(sorted(buckets)) if buckets else None
-        self._lock = threading.Lock()
+        self._lock = _locks.lock("serving.BucketedForward._lock")
         self.compiled_buckets: set = set()
 
     def bucket(self, n: int) -> int:
@@ -243,7 +244,7 @@ class MicroBatcher:
             if default_deadline_ms is None else default_deadline_ms) / 1e3
         self._q: "queue.Queue" = queue.Queue(maxsize=max(1, depth))
         self._carry: Optional[_Request] = None
-        self._lock = threading.Lock()
+        self._lock = _locks.lock("serving.MicroBatcher._lock")
         self._thread: Optional[threading.Thread] = None
         self._stopped = False
 
@@ -401,10 +402,18 @@ class MicroBatcher:
         return True
 
     def _loop(self) -> None:
+        stop_err = RuntimeError("serving batcher stopped")
         while True:
             req = self._pop(timeout=None)      # idle: block for work
             if req is _STOP:
                 return
+            if self._stopped:
+                # stop() raced this pop: it set _stopped and is draining
+                # the queue, but this request was already in our hands —
+                # fail it here, or its waiter would hang on a micro-batch
+                # that will never dispatch
+                self._fail([req], stop_err)
+                continue
             if self._expired(req, time.monotonic()):
                 continue
             batch = [req]
@@ -421,6 +430,9 @@ class MicroBatcher:
                     return
                 if nxt is None:
                     break
+                if self._stopped:
+                    self._fail(batch + [nxt], stop_err)
+                    return
                 if self._expired(nxt, time.monotonic()):
                     continue
                 if rows + nxt.n > self.max_batch:

@@ -34,6 +34,7 @@ from typing import Any, Callable, Optional, Sequence
 
 import numpy as np
 
+from .. import _locks
 from .. import config as _config
 from .. import faults as _faults
 from .. import metrics as _metrics
@@ -112,8 +113,8 @@ class InferenceEngine:
                             if warmup is None else warmup)
         self._example = None if example is None else np.asarray(example)
 
-        self._params_lock = threading.Lock()
-        self._reload_lock = threading.Lock()
+        self._params_lock = _locks.lock("serving.InferenceEngine._params_lock")
+        self._reload_lock = _locks.lock("serving.InferenceEngine._reload_lock")
         self._stop = threading.Event()
         self._poller: Optional[threading.Thread] = None
         self._manager = None

@@ -24,6 +24,7 @@ import threading
 import time
 from typing import Dict
 
+from . import _locks
 from . import config as _config
 from . import faults as _faults
 from . import metrics as _metrics
@@ -51,7 +52,7 @@ class StallInspector:
     def __init__(self, world):
         self._cfg = world.config
         self._world = world
-        self._lock = threading.Lock()
+        self._lock = _locks.lock("stall.StallInspector._lock")
         self._pending: Dict[str, float] = {}
         self._warned: Dict[str, bool] = {}
         self._nat = _native_get()
@@ -145,16 +146,23 @@ class StallInspector:
                 else []
         now = time.monotonic()
         newly = []
+        hit = False
+        # _warned is shared with record_done/stop (which pop/clear it
+        # under the lock); mutate it under the same lock here or a
+        # concurrent record_done can race this poll-thread write. The
+        # deadline flag stays outside: it is a monotonic bool read
+        # unguarded by waiters, set only here and cleared only by stop().
         with self._lock:
             items = list(self._pending.items())
+            for name, t0 in items:
+                if now - t0 > warn_after and not self._warned.get(name):
+                    self._warned[name] = True
+                    newly.append(name)
         for name, t0 in items:
-            waited = now - t0
-            if waited > warn_after and not self._warned.get(name):
-                self._warned[name] = True
-                newly.append(name)
-            if shutdown_after > 0 and waited > shutdown_after \
-                    and not self._stopped:
-                self._shutdown_deadline_hit = True
+            if shutdown_after > 0 and now - t0 > shutdown_after:
+                hit = True
+        if hit and not self._stopped:
+            self._shutdown_deadline_hit = True
         if self._shutdown_deadline_hit and not prior_hit:
             _M_STALL_SHUTDOWNS.inc()
         return newly

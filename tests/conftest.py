@@ -13,6 +13,12 @@ os.environ["XLA_FLAGS"] = (
     os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
 ).strip()
 os.environ["JAX_PLATFORMS"] = "cpu"
+# Run the whole suite under the lock-order sentinel (docs/static_analysis.md):
+# every lock built through horovod_tpu/_locks.py records per-thread
+# acquisition order and raises on an ordering violation, so a deadlock-shaped
+# regression fails a test instead of wedging a job. setdefault, so
+# HVD_TPU_LOCK_CHECK=0 can still turn it off for an overhead comparison.
+os.environ.setdefault("HVD_TPU_LOCK_CHECK", "1")
 
 import jax  # noqa: E402
 

@@ -1,0 +1,14 @@
+"""Seeded contract-lint bugs: an undocumented/untested fault site, an
+undocumented metric, and a label-set mismatch."""
+
+from . import faults as _faults
+from . import metrics as _metrics
+
+_FP = _faults.FaultPoint("ghost.site")          # undocumented + untested
+
+_M = _metrics.counter("hvd_tpu_ghost_total", "never documented",
+                      labels=("kind",))
+
+
+def hit():
+    _M.labels(wrong="x").inc()                  # label mismatch

@@ -1,0 +1,26 @@
+"""Seeded lock-order cycle, built through helper calls so the checker's
+call-graph edge propagation (not just lexical nesting) is what finds it."""
+
+import threading
+
+
+class AB:
+    def __init__(self):
+        self._a = threading.Lock()
+        self._b = threading.Lock()
+
+    def fwd(self):
+        with self._a:
+            self._take_b()      # A held -> (via call) acquires B
+
+    def _take_b(self):
+        with self._b:
+            pass
+
+    def rev(self):
+        with self._b:
+            self._take_a()      # B held -> (via call) acquires A: cycle
+
+    def _take_a(self):
+        with self._a:
+            pass

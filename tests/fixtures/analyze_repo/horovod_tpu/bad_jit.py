@@ -1,0 +1,21 @@
+"""Seeded jit-purity bugs: host effects inside a jit-traced function."""
+
+import os
+import time
+
+import jax
+import numpy as np
+
+
+def make_step():
+    cache = []
+
+    def _step(params, x):
+        time.time()                     # BUG: clock read at trace time
+        y = np.asarray(x)               # BUG: numpy on a tracer
+        cache.append(y)                 # BUG: captured-state mutation
+        if os.environ.get("HVD_DEBUG"):  # BUG: env read freezes
+            pass
+        return params
+
+    return jax.jit(_step)

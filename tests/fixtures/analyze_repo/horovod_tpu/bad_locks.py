@@ -1,0 +1,23 @@
+"""Seeded lock-discipline bugs: tests/test_static_analysis.py asserts
+the checker reports exactly these (and nothing on the clean fixtures)."""
+
+import threading
+
+
+class Worker:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._items = []
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
+    def _run(self):
+        with self._lock:
+            self._items.append(1)
+
+    def reset(self):
+        self._items = []        # BUG(line 19): guarded attr written bare
+
+    def wait_holding_lock(self, other):
+        with self._lock:
+            other.join()        # BUG(line 23): unbounded join under lock

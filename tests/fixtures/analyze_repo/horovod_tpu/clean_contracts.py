@@ -1,0 +1,15 @@
+"""Clean contract usage: documented + drilled fault site, documented
+metric with a consistent label set — zero findings expected."""
+
+from . import faults as _faults
+from . import metrics as _metrics
+
+_FP = _faults.FaultPoint("clean.site")
+
+_M = _metrics.counter("hvd_tpu_clean_total", "documented",
+                      labels=("kind",))
+
+
+def hit():
+    _FP.fire()
+    _M.labels(kind="ok").inc()

@@ -1,0 +1,22 @@
+"""Pure jit-traced functions — zero findings expected."""
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def scale(x):
+    y = jnp.asarray(x)
+    return y * 2.0
+
+
+def make(fn):
+    return jax.jit(lambda p, x: fn(p, x) + jnp.ones(3))
+
+
+def make_functional(opt):
+    def _step(p, s, g):
+        updates, s = opt.update(g, s, p)    # pure optax style: no finding
+        return p, s
+
+    return jax.jit(_step)

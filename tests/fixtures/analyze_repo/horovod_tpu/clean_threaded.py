@@ -1,0 +1,36 @@
+"""Clean threaded class: correct discipline everywhere, including the
+``*_locked`` private-helper pattern (writes guarded at every call site)
+— must produce zero findings (the false-positive fence)."""
+
+import threading
+
+
+class CleanWorker:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._items = []
+        self._count = 0
+        self._stopped = False
+        self._thread = threading.Thread(target=self._run, daemon=True)
+
+    def _run(self):
+        while not self._stopped:        # benign racy read: not a finding
+            with self._lock:
+                self._append_locked(1)
+
+    def _append_locked(self, item):
+        # only ever called with self._lock held: the held-at-entry
+        # propagation must classify these writes as guarded
+        self._items.append(item)
+        self._count += 1
+
+    def add(self, item):
+        with self._lock:
+            self._append_locked(item)
+
+    def snapshot(self):
+        with self._lock:
+            return list(self._items)
+
+    def join_without_lock(self):
+        self._thread.join(timeout=1)    # bounded, lock-free: not a finding

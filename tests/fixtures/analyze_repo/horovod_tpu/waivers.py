@@ -1,0 +1,25 @@
+"""Waiver-machinery fixture: one properly-waived finding, one waiver
+with no reason (a violation), one stale waiver (a violation)."""
+
+import threading
+
+
+class Waived:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._items = []
+        self._thread = threading.Thread(target=self._run, daemon=True)
+
+    def _run(self):
+        with self._lock:
+            self._items.append(1)
+
+    def reset(self):
+        # hvd-lint: waive[lock-discipline] fixture: reset is documented single-threaded
+        self._items = []
+
+    def bare(self):
+        self._other = 0     # hvd-lint: waive[lock-discipline]
+
+    def fine(self):
+        pass                # hvd-lint: waive[lock-discipline] fixture: nothing suppressed here

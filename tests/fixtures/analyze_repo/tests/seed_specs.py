@@ -1,0 +1,3 @@
+"""Fixture 'tests': the spec literal that marks clean.site as drilled."""
+
+SPEC = "clean.site:error:once"

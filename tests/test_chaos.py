@@ -360,6 +360,82 @@ class TestCollectiveFaults:
 
 
 # ---------------------------------------------------------------------------
+# per-site drill coverage: every FaultPoint the contract lint tracks
+# (tools/analyze, fault-sites checker) must be exercised by a seeded test
+# ---------------------------------------------------------------------------
+
+class TestPerVerbCollectiveFaults:
+    """Each collective verb owns its own FaultPoint (``collective.<verb>``,
+    collectives.py); allreduce's drill lives above. One parametrized drill
+    per remaining verb: an ``error:once`` at the verb's own site surfaces
+    as HorovodInternalError (the elastic recovery trigger) and the very
+    next call of the same verb is clean — the schedule was consumed at
+    the right point, not at a sibling verb's."""
+
+    # full literal spec per verb: the fault-sites contract lint harvests
+    # these strings to prove every site has a seeded drill
+    VERBS = [
+        ("collective.grouped_allreduce:error:once",
+         lambda hvd: hvd.grouped_allreduce(
+             [np.ones(3, np.float32)], op=hvd.Sum, name="chaos.gar")),
+        ("collective.allgather:error:once",
+         lambda hvd: hvd.allgather(np.ones((2, 2), np.float32))),
+        ("collective.broadcast:error:once",
+         lambda hvd: hvd.broadcast(np.ones(3, np.float32), root_rank=0)),
+        ("collective.grouped_broadcast:error:once",
+         lambda hvd: hvd.grouped_broadcast(
+             [np.ones(3, np.float32)], root_rank=0)),
+        ("collective.alltoall:error:once",
+         lambda hvd: hvd.alltoall(np.ones(4, np.float32))),
+    ]
+
+    @pytest.mark.parametrize("spec,call", VERBS, ids=[s for s, _ in VERBS])
+    def test_injected_verb_error_surfaces_then_clears(self, hvd_world,
+                                                      spec, call):
+        site = spec.split(":", 1)[0]
+        series = ('hvd_tpu_faults_injected_total'
+                  f'{{site="{site}",kind="error"}}')
+        before = M.snapshot().get(series, 0)
+        F.configure(spec, seed=SEED)
+        with pytest.raises(HorovodInternalError, match="injected fault"):
+            call(hvd_world)
+        assert M.snapshot().get(series, 0) - before == 1
+        # 'once' consumed: the same verb immediately works again
+        call(hvd_world)
+
+
+class TestElasticControlPlaneFaults:
+    """Seeded drills for the host-plane control-channel sites the e2e
+    suites only reach indirectly: discovery polls and driver->worker
+    notification pushes."""
+
+    def test_discovery_fault_behaves_like_failing_script(self):
+        """An injected elastic.discovery error raises the same
+        RuntimeError a failing --host-discovery-script does (fatal on
+        the first poll, logged-and-retried on later ones); the next poll
+        runs the real script again."""
+        from horovod_tpu.elastic.discovery import HostDiscoveryScript
+        F.configure("elastic.discovery:error:once", seed=SEED)
+        disco = HostDiscoveryScript("echo hostA:2")
+        with pytest.raises(RuntimeError, match="injected fault"):
+            disco.find_available_hosts_and_slots()
+        assert disco.find_available_hosts_and_slots() == {"hostA": 2}
+
+    def test_notify_fault_is_transient_shaped(self):
+        """elastic.notify simulates a blip on the driver's hosts-updated
+        push: the injected fault is connection-shaped (so the driver's
+        retry/cleanup paths classify it transient) and fires before any
+        socket work."""
+        from horovod_tpu.elastic.worker import WorkerNotificationClient
+        from horovod_tpu.runner.network import make_secret_key
+        F.configure("elastic.notify:error:once", seed=SEED)
+        cli = WorkerNotificationClient({"lo": [("127.0.0.1", 9)]},
+                                       make_secret_key(), timeout=0.2)
+        with pytest.raises(ConnectionError, match="injected"):
+            cli.notify_hosts_updated(time.time())
+
+
+# ---------------------------------------------------------------------------
 # stall inspector: injected deadline + idempotent stop
 # ---------------------------------------------------------------------------
 

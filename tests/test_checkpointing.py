@@ -354,6 +354,31 @@ class TestIntegrity:
         b = _run_crash_drill(tmp_path / "b")
         assert a == b
 
+    @pytest.mark.chaos
+    def test_manifest_crash_leaves_partial_and_writer_restarts(
+            self, tmp_path):
+        """checkpoint.manifest drill: the writer dies after every shard
+        landed but before the manifest/COMMIT — the step must still be
+        invisible (shards without a manifest are garbage, not a
+        checkpoint) and the hot-restarted writer must commit the next
+        save normally."""
+        mgr = cp.CheckpointManager(str(tmp_path))
+        mgr.save(1, {"w": jnp.arange(8, dtype=jnp.float32)}, async_=False)
+        F.configure("checkpoint.manifest:crash:once", seed=SEED)
+        mgr.save(2, {"w": jnp.ones(8, jnp.float32)})        # async
+        with pytest.raises(cp.CheckpointWriterCrashed):
+            mgr.wait_until_finished()
+        F.configure("", seed=0)
+        assert layout.classify(layout.step_dir(str(tmp_path), 2)) \
+            == layout.PARTIAL
+        assert mgr.latest_step() == 1
+        # writer hot-restart: the next async save commits end to end
+        mgr.save(3, {"w": jnp.full(8, 3.0, jnp.float32)})
+        mgr.wait_until_finished()
+        assert mgr.latest_step() == 3
+        np.testing.assert_allclose(
+            np.asarray(mgr.restore()["w"]), np.full(8, 3.0))
+
     def test_checksum_corruption_detected_and_walked_past(self, tmp_path):
         tree1 = {"w": jnp.arange(16, dtype=jnp.float32)}
         mgr = cp.CheckpointManager(str(tmp_path))

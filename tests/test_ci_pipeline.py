@@ -151,6 +151,23 @@ def test_serving_suite_is_seeded_and_exclusive():
     assert os.path.exists(os.path.join(root, "tests", "test_serving.py"))
 
 
+def test_lint_static_suite_in_every_service():
+    """The unified static-analysis suite (tools/analyze: lock-discipline,
+    lock-order, contract lints, jit-purity, knobs) runs as its own CI
+    suite on every service, and the module it invokes exists."""
+    names = [name for name, _cmd, _t in COMMON_SUITES]
+    assert "lint-static" in names
+    by_name = {name: cmd for name, cmd, _t in COMMON_SUITES}
+    assert by_name["lint-static"] == "python -m tools.analyze"
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    assert os.path.exists(os.path.join(root, "tools", "analyze",
+                                       "__main__.py"))
+    # the "tree is lint-clean" contract itself is asserted once, in
+    # tests/test_static_analysis.py (in-process + CLI) — not repeated
+    # here: tier-1 is wallclock-budgeted and each full-repo analysis
+    # run costs seconds
+
+
 def test_check_knobs_lint_is_clean():
     """The knob lint must pass on the tree as committed: every HVD_TPU_*
     env var read in the package is registered in config.py and documented

@@ -508,6 +508,54 @@ class TestInferenceEngine:
 
 
 # ---------------------------------------------------------------------------
+# seeded drills for the admission/batch fault sites
+# ---------------------------------------------------------------------------
+
+class TestAdmitAndBatchFaults:
+    """The two batcher-side sites (the engine-side forward/reload drills
+    live above): an injected ``serving.admit`` error looks exactly like
+    admission backpressure (QueueFullError -> 503), an injected
+    ``serving.batch`` error fails that one micro-batch and the batcher
+    keeps serving."""
+
+    def _batcher(self):
+        p = _params(1.0)
+        return MicroBatcher(lambda x, n: _apply(p, x), max_batch=4,
+                            timeout_ms=0, queue_depth=8,
+                            default_deadline_ms=0)
+
+    def test_admit_fault_is_backpressure_shaped(self):
+        series = ('hvd_tpu_faults_injected_total'
+                  '{site="serving.admit",kind="error"}')
+        before = M.snapshot().get(series, 0)
+        F.configure("serving.admit:error:once", seed=SEED)
+        b = self._batcher()
+        try:
+            with pytest.raises(QueueFullError, match="injected"):
+                b.submit(_rows(1))
+            assert M.snapshot().get(series, 0) - before == 1
+            # 'once' consumed: admission works and the answer is right
+            out = b.infer(_rows(2), timeout=10)
+            np.testing.assert_allclose(out, _apply(_params(1.0), _rows(2)))
+        finally:
+            b.stop()
+
+    def test_batch_fault_fails_one_micro_batch_then_recovers(self):
+        F.configure("serving.batch:error:once", seed=SEED)
+        b = self._batcher()
+        try:
+            req = b.submit(_rows(1))
+            with pytest.raises(F.InjectedFault, match="serving.batch"):
+                b.result(req, timeout=10)
+            # the batcher thread survived its failed batch: next request
+            # coalesces and serves normally
+            out = b.infer(_rows(3), timeout=10)
+            np.testing.assert_allclose(out, _apply(_params(1.0), _rows(3)))
+        finally:
+            b.stop()
+
+
+# ---------------------------------------------------------------------------
 # seeded determinism of the serving fault sites
 # ---------------------------------------------------------------------------
 

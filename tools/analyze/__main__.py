@@ -1,0 +1,60 @@
+"""CLI: ``python -m tools.analyze [--checkers a,b] [--format github]``.
+
+Exit status 0 only when every finding is waived (with a reason), no
+waiver is stale or reasonless, and the live-waiver count stays within
+the budget pinned in tools/analyze/core.py.
+"""
+
+import argparse
+import sys
+
+from . import core
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m tools.analyze",
+        description="horovod_tpu concurrency-aware static analysis")
+    parser.add_argument(
+        "--checkers", default="",
+        help="comma-separated subset to run (default: all); "
+             f"available: {', '.join(sorted(core.CHECKERS) or ['(all)'])}")
+    parser.add_argument(
+        "--format", choices=("text", "github"), default="text",
+        help="'github' emits ::error/::notice workflow-command "
+             "annotations for PR checks")
+    parser.add_argument(
+        "--root", default=core.REPO,
+        help="repository root to analyze (default: this repo)")
+    parser.add_argument(
+        "--hide-waived", action="store_true",
+        help="omit waived findings from the report")
+    parser.add_argument("--list", action="store_true",
+                        help="list available checkers and exit")
+    args = parser.parse_args(argv)
+
+    from . import ALL_CHECKERS  # noqa: F401 — populate the registry
+    if args.list:
+        for name in sorted(core.CHECKERS):
+            print(name)
+        return 0
+
+    names = [n for n in args.checkers.split(",") if n] or None
+    ctx = core.Context(args.root)
+    findings, waivers = core.run(ctx, names)
+    if args.format == "github":
+        out = core.render_github(findings)
+        if out:
+            print(out)
+    else:
+        print(core.render_text(findings, waivers,
+                               show_waived=not args.hide_waived))
+    rc = core.verdict(findings, waivers)
+    if rc and len(waivers) > core.WAIVER_BUDGET:
+        print(f"tools.analyze: waiver budget exceeded "
+              f"({len(waivers)} > {core.WAIVER_BUDGET})", file=sys.stderr)
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

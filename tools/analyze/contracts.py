@@ -1,0 +1,285 @@
+"""Contract lints: fault-site and metric registries stay closed.
+
+``fault-sites`` — every ``FaultPoint("site")`` constructed in the
+package must be (a) documented in ``docs/robustness.md`` (the site table
+is the operator's chaos-drill menu) and (b) exercised by at least one
+*seeded test*: a fault-spec string in ``tests/`` whose site field
+matches the point exactly or as a dot-boundary prefix (the same matching
+rule ``horovod_tpu/faults.py`` applies at runtime). An injection point
+nobody can schedule is dead weight; one nobody *does* schedule is an
+untested failure path.
+
+``metrics`` — every metric family registered through
+``_metrics.counter/gauge/histogram("name", ...)`` must be registered
+exactly once across the package, documented in ``docs/metrics.md``, and
+used with exactly its declared label set at every ``.labels(...)`` call
+site (the runtime registry raises on a label mismatch — this lint moves
+that crash to CI).
+"""
+
+import ast
+import re
+from typing import Dict, List, Optional, Set, Tuple
+
+from .core import Context, Finding, checker
+
+#: fault-spec kinds accepted when harvesting spec strings from tests —
+#: mirrors horovod_tpu/faults.py ``_KINDS`` plus the bare param forms
+_SPEC_ENTRY = re.compile(
+    r"^([A-Za-z_][A-Za-z0-9_.]*)\s*:\s*"
+    r"(error|neterror|crash|delay=[-0-9.e]+|hang(=[-0-9.e]+)?)"
+    r"(:[A-Za-z0-9_.=-]+)*$")
+
+
+def _parent_map(tree: ast.AST) -> Dict[ast.AST, ast.AST]:
+    parents = {}
+    for node in ast.walk(tree):
+        for child in ast.iter_child_nodes(node):
+            parents[child] = node
+    return parents
+
+
+def _expand_site(arg: ast.AST, parents: Dict[ast.AST, ast.AST]
+                 ) -> Optional[List[str]]:
+    """Site names from a FaultPoint's first argument. Handles the
+    constant case and the one dynamic idiom the package uses — an
+    f-string whose only placeholder is a comprehension variable
+    iterating a literal tuple (``FaultPoint(f"collective.{kind}") for
+    kind in (...)``). Returns None when unresolvable."""
+    if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+        return [arg.value]
+    if not isinstance(arg, ast.JoinedStr):
+        return None
+    placeholders = [v for v in arg.values
+                    if isinstance(v, ast.FormattedValue)]
+    if len(placeholders) != 1 or \
+            not isinstance(placeholders[0].value, ast.Name):
+        return None
+    var = placeholders[0].value.id
+    # climb to an enclosing comprehension binding ``var`` to literals
+    node = arg
+    while node in parents:
+        node = parents[node]
+        if isinstance(node, (ast.ListComp, ast.SetComp, ast.DictComp,
+                             ast.GeneratorExp)):
+            for gen in node.generators:
+                if isinstance(gen.target, ast.Name) and \
+                        gen.target.id == var and \
+                        isinstance(gen.iter, (ast.Tuple, ast.List)) and \
+                        all(isinstance(e, ast.Constant)
+                            for e in gen.iter.elts):
+                    values = [str(e.value) for e in gen.iter.elts]
+                    out = []
+                    for v in values:
+                        parts = []
+                        for piece in arg.values:
+                            if isinstance(piece, ast.Constant):
+                                parts.append(str(piece.value))
+                            else:
+                                parts.append(v)
+                        out.append("".join(parts))
+                    return out
+    return None
+
+
+def _fault_sites(ctx: Context) -> List[Tuple[str, str, int]]:
+    """(site, rel_path, line) for every FaultPoint constructed in the
+    package (faults.py itself excluded — it defines the class)."""
+    out = []
+    for src in ctx.package_files:
+        if src.tree is None or src.rel.endswith("faults.py"):
+            continue
+        parents = _parent_map(src.tree)
+        for node in ast.walk(src.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            fn = node.func
+            name = fn.attr if isinstance(fn, ast.Attribute) else (
+                fn.id if isinstance(fn, ast.Name) else "")
+            if name != "FaultPoint" or not node.args:
+                continue
+            sites = _expand_site(node.args[0], parents)
+            if sites is None:
+                out.append((None, src.rel, node.lineno))
+            else:
+                for s in sites:
+                    out.append((s, src.rel, node.lineno))
+    return out
+
+
+def tested_spec_sites(ctx: Context) -> Set[str]:
+    """Site fields of every fault-spec entry found in a string literal
+    anywhere under tests/ — both ``HVD_TPU_FAULT_SPEC`` env values and
+    ``faults.configure(...)`` arguments end up here."""
+    sites: Set[str] = set()
+    for src in ctx.test_files:
+        if src.tree is None:
+            continue
+        for node in ast.walk(src.tree):
+            if isinstance(node, ast.Constant) and \
+                    isinstance(node.value, str) and ":" in node.value:
+                for entry in node.value.split(";"):
+                    m = _SPEC_ENTRY.match(entry.strip())
+                    if m:
+                        sites.add(m.group(1))
+    return sites
+
+
+def _covered(site: str, spec_sites: Set[str]) -> bool:
+    return any(site == s or site.startswith(s + ".") for s in spec_sites)
+
+
+@checker("fault-sites")
+def run_fault_sites(ctx: Context) -> List[Finding]:
+    findings: List[Finding] = []
+    robustness = ctx.docs.get("robustness.md", "")
+    spec_sites = tested_spec_sites(ctx)
+    seen: Dict[str, Tuple[str, int]] = {}
+    for site, rel, line in _fault_sites(ctx):
+        if site is None:
+            findings.append(Finding(
+                "fault-sites", rel, line,
+                "FaultPoint site name is not statically resolvable — "
+                "use a string literal (or an f-string over a literal "
+                "tuple) so the contract lint can track it"))
+            continue
+        if site in seen and seen[site] != (rel, line):
+            findings.append(Finding(
+                "fault-sites", rel, line,
+                f"fault site {site!r} constructed more than once "
+                f"(also at {seen[site][0]}:{seen[site][1]}) — two "
+                f"points sharing a name get independent injection "
+                f"schedules and break drill determinism"))
+            continue
+        seen[site] = (rel, line)
+        if site not in robustness:
+            findings.append(Finding(
+                "fault-sites", rel, line,
+                f"fault site {site!r} is not documented in "
+                f"docs/robustness.md — add it to the site table "
+                f"(the operator's chaos-drill menu)"))
+        if not _covered(site, spec_sites):
+            findings.append(Finding(
+                "fault-sites", rel, line,
+                f"fault site {site!r} is not exercised by any seeded "
+                f"test: no fault-spec string under tests/ matches it "
+                f"(exactly or as a dot-boundary prefix) — add a drill "
+                f"that injects here"))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# metrics registry contract
+# ---------------------------------------------------------------------------
+
+_METRIC_KINDS = {"counter", "gauge", "histogram"}
+
+_BRACE = re.compile(r"[A-Za-z0-9_]*\{[A-Za-z0-9_,]+\}[A-Za-z0-9_]*")
+
+
+def _with_brace_expansions(doc: str) -> str:
+    """docs/metrics.md uses ``hvd_tpu_stall_{warnings,shutdowns}_total``
+    shorthand for families that differ in one segment; expand those so
+    the documented-name check accepts either spelling."""
+    extra = []
+    for m in _BRACE.finditer(doc):
+        tok = m.group(0)
+        pre, _, rest = tok.partition("{")
+        inner, _, post = rest.partition("}")
+        if "," in inner:
+            extra.extend(pre + part + post for part in inner.split(","))
+    return doc + "\n" + "\n".join(extra)
+
+
+def _registrations(src) -> List[Tuple[str, Tuple[str, ...], int, str]]:
+    """(name, labels, line, bound_var) per ``_metrics.<kind>("name", ...)``
+    call; bound_var is the module-level variable it is assigned to
+    ('' when unbound)."""
+    out = []
+    for node in ast.walk(src.tree):
+        target = ""
+        call = None
+        if isinstance(node, ast.Assign) and \
+                isinstance(node.value, ast.Call):
+            call = node.value
+            if len(node.targets) == 1 and \
+                    isinstance(node.targets[0], ast.Name):
+                target = node.targets[0].id
+        elif isinstance(node, ast.Call):
+            call = node
+        if call is None:
+            continue
+        fn = call.func
+        if not (isinstance(fn, ast.Attribute) and
+                fn.attr in _METRIC_KINDS and
+                isinstance(fn.value, ast.Name) and
+                "metrics" in fn.value.id):
+            continue
+        if not call.args or not isinstance(call.args[0], ast.Constant):
+            continue
+        labels: Tuple[str, ...] = ()
+        for kw in call.keywords:
+            if kw.arg == "labels" and \
+                    isinstance(kw.value, (ast.Tuple, ast.List)) and \
+                    all(isinstance(e, ast.Constant) for e in kw.value.elts):
+                labels = tuple(str(e.value) for e in kw.value.elts)
+        if isinstance(node, ast.Assign):
+            out.append((str(call.args[0].value), labels, call.lineno,
+                        target))
+        elif not isinstance(node, ast.Assign):
+            # bare registration (rare); keep it, unbound
+            out.append((str(call.args[0].value), labels, call.lineno, ""))
+    return out
+
+
+@checker("metrics")
+def run_metrics(ctx: Context) -> List[Finding]:
+    findings: List[Finding] = []
+    metrics_doc = _with_brace_expansions(ctx.docs.get("metrics.md", ""))
+    registered: Dict[str, Tuple[str, int, Tuple[str, ...]]] = {}
+    for src in ctx.package_files:
+        if src.tree is None or src.rel.endswith("horovod_tpu/metrics.py"):
+            continue
+        regs = _registrations(src)
+        # de-dup: ast.walk visits the Assign AND its nested Call
+        uniq = {}
+        for name, labels, line, var in regs:
+            key = (name, line)
+            if key not in uniq or var:
+                uniq[key] = (name, labels, line, var)
+        by_var: Dict[str, Tuple[str, Tuple[str, ...]]] = {}
+        for name, labels, line, var in uniq.values():
+            if var:
+                by_var[var] = (name, labels)
+            prev = registered.get(name)
+            if prev is not None and (prev[0], prev[1]) != (src.rel, line):
+                findings.append(Finding(
+                    "metrics", src.rel, line,
+                    f"metric {name!r} registered more than once (also "
+                    f"at {prev[0]}:{prev[1]}) — one family must have "
+                    f"exactly one owner"))
+                continue
+            registered[name] = (src.rel, line, labels)
+            if name not in metrics_doc:
+                findings.append(Finding(
+                    "metrics", src.rel, line,
+                    f"metric {name!r} is not documented in "
+                    f"docs/metrics.md — add a table row"))
+        # label-set consistency at .labels(...) call sites
+        for node in ast.walk(src.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            fn = node.func
+            if not (isinstance(fn, ast.Attribute) and fn.attr == "labels"
+                    and isinstance(fn.value, ast.Name)
+                    and fn.value.id in by_var):
+                continue
+            name, labels = by_var[fn.value.id]
+            used = tuple(sorted(kw.arg for kw in node.keywords if kw.arg))
+            if used != tuple(sorted(labels)):
+                findings.append(Finding(
+                    "metrics", src.rel, node.lineno,
+                    f"metric {name!r} is registered with labels "
+                    f"{tuple(sorted(labels))} but used here with "
+                    f"{used} — the registry raises on this at runtime"))
+    return findings

@@ -1,0 +1,264 @@
+"""Core of the ``tools.analyze`` static-analysis framework.
+
+``tools/check_knobs.py`` proved the shape — turn a cross-cutting
+contract into a CI failure with ``file:line`` findings — and this module
+generalizes it: a checker is a function ``run(ctx) -> [Finding]`` over a
+pre-parsed view of the repository (:class:`Context`), findings are
+suppressable inline with a *reasoned* waiver comment::
+
+    some_code()   # hvd-lint: waive[lock-discipline] single-threaded by contract
+
+and the total number of live waivers is budgeted
+(:data:`WAIVER_BUDGET`), so suppression stays an explicit, reviewed
+escape hatch instead of a slow leak. A waiver with no reason is itself a
+violation, and so is a waiver that suppresses nothing (staleness would
+otherwise hide a later regression at the same line).
+
+Checkers register themselves in :data:`CHECKERS` (name -> run callable);
+``python -m tools.analyze`` runs them all. See docs/static_analysis.md.
+"""
+
+import ast
+import dataclasses
+import os
+import re
+from typing import Dict, List, Optional, Tuple
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+PACKAGE_DIR = os.path.join(REPO, "horovod_tpu")
+TESTS_DIR = os.path.join(REPO, "tests")
+DOCS_DIR = os.path.join(REPO, "docs")
+
+#: Hard cap on live waivers across the repo. Raising it is a reviewed
+#: change to this line, mirrored by the pin in
+#: tests/test_static_analysis.py — a PR that adds waivers must defend
+#: them in both places.
+WAIVER_BUDGET = 12
+
+#: ``# hvd-lint: waive[checker] reason`` — suppresses findings of
+#: ``checker`` on this line and the line directly below (so a waiver can
+#: sit on its own line above a long statement).
+_WAIVE_RE = re.compile(
+    r"#\s*hvd-lint:\s*waive\[([A-Za-z0-9_-]+)\]\s*(.*?)\s*$")
+
+
+@dataclasses.dataclass
+class Finding:
+    """One violation: ``checker``, repo-relative ``path``, 1-based
+    ``line``, human message. ``waived``/``waive_reason`` are filled in by
+    :func:`apply_waivers`."""
+
+    checker: str
+    path: str
+    line: int
+    message: str
+    waived: bool = False
+    waive_reason: str = ""
+
+    def location(self) -> str:
+        return f"{self.path}:{self.line}"
+
+    def render(self) -> str:
+        tag = f" [waived: {self.waive_reason}]" if self.waived else ""
+        return f"{self.location()}: [{self.checker}] {self.message}{tag}"
+
+
+@dataclasses.dataclass
+class Waiver:
+    checker: str
+    reason: str
+    path: str
+    line: int
+    used: bool = False
+
+
+class SourceFile:
+    """One parsed python file: text, lines, AST (None on syntax error)
+    and its inline waivers."""
+
+    def __init__(self, path: str, rel: str):
+        self.path = path
+        self.rel = rel
+        with open(path, encoding="utf-8") as f:
+            self.text = f.read()
+        self.lines = self.text.splitlines()
+        try:
+            self.tree: Optional[ast.AST] = ast.parse(self.text,
+                                                     filename=rel)
+        except SyntaxError:
+            self.tree = None
+        self.waivers: List[Waiver] = []
+        for lineno, line in enumerate(self.lines, 1):
+            m = _WAIVE_RE.search(line)
+            if m:
+                self.waivers.append(
+                    Waiver(m.group(1), m.group(2), rel, lineno))
+
+
+class Context:
+    """Everything a checker may look at, parsed once and shared."""
+
+    def __init__(self, root: str = REPO):
+        self.root = root
+        self.package_files = self._collect(os.path.join(root, "horovod_tpu"))
+        self.test_files = self._collect(os.path.join(root, "tests"))
+        self.docs = {}
+        docs_dir = os.path.join(root, "docs")
+        if os.path.isdir(docs_dir):
+            for fname in sorted(os.listdir(docs_dir)):
+                if fname.endswith(".md"):
+                    with open(os.path.join(docs_dir, fname),
+                              encoding="utf-8") as f:
+                        self.docs[fname] = f.read()
+
+    def _collect(self, base: str) -> List[SourceFile]:
+        out = []
+        for dirpath, dirnames, files in os.walk(base):
+            # "fixtures" holds the analyzer's own seeded-bug mini-repos
+            # (tests/fixtures/analyze_repo): deliberately buggy files and
+            # spec strings that must not leak into the real analysis
+            dirnames[:] = sorted(
+                d for d in dirnames if d not in ("__pycache__", "fixtures"))
+            for fname in sorted(files):
+                if not fname.endswith(".py"):
+                    continue
+                path = os.path.join(dirpath, fname)
+                out.append(SourceFile(
+                    path, os.path.relpath(path, self.root)))
+        return out
+
+    def module_name(self, src: SourceFile) -> str:
+        """Dotted module path for a package file
+        (``horovod_tpu/serving/batcher.py`` -> ``serving.batcher``)."""
+        rel = os.path.relpath(src.path, os.path.join(self.root,
+                                                     "horovod_tpu"))
+        mod = rel[:-3].replace(os.sep, ".")
+        if mod.endswith(".__init__"):
+            mod = mod[: -len(".__init__")]
+        return mod
+
+
+#: name -> run(ctx) callable; populated by the checker modules' import
+#: (see tools/analyze/__init__.py).
+CHECKERS: Dict[str, object] = {}
+
+
+def checker(name: str):
+    def deco(fn):
+        CHECKERS[name] = fn
+        fn.checker_name = name
+        return fn
+    return deco
+
+
+def apply_waivers(findings: List[Finding],
+                  files: List[SourceFile],
+                  ran: Optional[set] = None) -> List[Finding]:
+    """Mark findings covered by an inline waiver; append violations for
+    reasonless and unused waivers. Returns the combined list. ``ran``
+    is the set of checker names that actually ran this invocation: a
+    waiver for a checker that did not run is left alone rather than
+    flagged stale, so ``--checkers`` subset runs stay clean on a tree
+    that is clean under a full run."""
+    by_loc: Dict[Tuple[str, int], List[Waiver]] = {}
+    all_waivers: List[Waiver] = []
+    for src in files:
+        for w in src.waivers:
+            all_waivers.append(w)
+            # a waiver covers its own line and the line below it
+            by_loc.setdefault((w.path, w.line), []).append(w)
+            by_loc.setdefault((w.path, w.line + 1), []).append(w)
+    for f in findings:
+        for w in by_loc.get((f.path, f.line), ()):
+            if w.checker == f.checker and w.reason:
+                f.waived = True
+                f.waive_reason = w.reason
+                w.used = True
+                break
+    extra = []
+    for w in all_waivers:
+        if not w.reason:
+            extra.append(Finding(
+                "waiver", w.path, w.line,
+                f"waive[{w.checker}] carries no reason — every waiver "
+                f"must say why the finding is acceptable"))
+        elif not w.used and (ran is None or w.checker in ran):
+            extra.append(Finding(
+                "waiver", w.path, w.line,
+                f"stale waiver: waive[{w.checker}] suppresses nothing "
+                f"here — remove it (stale waivers hide future "
+                f"regressions at this line)"))
+    return findings + extra
+
+
+def run(ctx: Optional[Context] = None,
+        checkers: Optional[List[str]] = None
+        ) -> Tuple[List[Finding], List[Waiver]]:
+    """Run the selected checkers (default: all), apply waivers, and
+    return (findings, live waivers)."""
+    from . import ALL_CHECKERS  # noqa: F401 — registers CHECKERS
+    ctx = ctx or Context()
+    names = checkers or sorted(CHECKERS)
+    unknown = [n for n in names if n not in CHECKERS]
+    if unknown:
+        raise ValueError(f"unknown checker(s) {unknown}; "
+                         f"have {sorted(CHECKERS)}")
+    findings: List[Finding] = []
+    for name in names:
+        findings.extend(CHECKERS[name](ctx))
+    findings = apply_waivers(findings,
+                             ctx.package_files + ctx.test_files,
+                             ran=set(names))
+    findings.sort(key=lambda f: (f.path, f.line, f.checker))
+    live = [w for src in ctx.package_files + ctx.test_files
+            for w in src.waivers if w.used]
+    return findings, live
+
+
+# -- report rendering --------------------------------------------------------
+
+def render_text(findings: List[Finding], waivers: List[Waiver],
+                show_waived: bool = True) -> str:
+    lines = []
+    unwaived = [f for f in findings if not f.waived]
+    for f in findings:
+        if f.waived and not show_waived:
+            continue
+        lines.append(("  ~ " if f.waived else "  - ") + f.render())
+    lines.append(
+        f"tools.analyze: {len(unwaived)} finding(s), "
+        f"{len(waivers)} waiver(s) (budget {WAIVER_BUDGET})")
+    return "\n".join(lines)
+
+
+def render_github(findings: List[Finding]) -> str:
+    """GitHub Actions workflow-command annotations: one ``::error``
+    per unwaived finding, ``::notice`` per waived one, so findings
+    render inline on the PR diff."""
+
+    def esc(msg: str) -> str:
+        # workflow-command data escaping (docs.github.com: toolkit
+        # commands): % first, then newlines
+        return (msg.replace("%", "%25").replace("\r", "%0D")
+                .replace("\n", "%0A"))
+
+    lines = []
+    for f in findings:
+        level = "notice" if f.waived else "error"
+        msg = f.message if not f.waived \
+            else f"{f.message} [waived: {f.waive_reason}]"
+        lines.append(
+            f"::{level} file={f.path},line={f.line},"
+            f"title=hvd-lint[{f.checker}]::{esc(msg)}")
+    return "\n".join(lines)
+
+
+def verdict(findings: List[Finding], waivers: List[Waiver]) -> int:
+    """Process exit code: 0 only when no unwaived findings and the
+    waiver budget holds."""
+    if any(not f.waived for f in findings):
+        return 1
+    if len(waivers) > WAIVER_BUDGET:
+        return 1
+    return 0

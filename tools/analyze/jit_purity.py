@@ -1,0 +1,207 @@
+"""``jit-purity``: host side effects inside jit-traced functions.
+
+A function handed to ``jax.jit`` runs twice in spirit: once at *trace*
+time (python executes, tracers flow) and then as the compiled program.
+Host-side work in the body silently freezes at trace time — an
+``os.environ`` read becomes a compile-time constant, ``time``/``random``
+calls produce one value forever, ``np.*`` on a tracer forces a
+concretization error or a silent host constant, and mutating captured
+state (``self.x = ...``, ``cache.append(...)``) runs once per
+*recompile*, not once per call. With the in-jit fast path (ROADMAP
+item 2) these become silent-staleness bugs, so they get flagged here.
+
+What counts as jit-traced: functions decorated ``@jax.jit`` /
+``@partial(jax.jit, ...)``, and the function or lambda passed as the
+first argument to any ``*.jit(...)`` call (``jax.jit(f)``,
+``_jax().jit(f)``) when it is defined in the same module scope.
+"""
+
+import ast
+from typing import Dict, List, Optional, Set
+
+from .core import Context, Finding, checker
+
+NAME = "jit-purity"
+
+#: receivers whose any-method call is a host clock/rng/env read
+_IMPURE_MODULES = {"time", "random", "datetime", "socket", "subprocess"}
+_HOST_ARRAY_MODULES = {"np", "numpy"}
+_MUTATORS = {"append", "add", "update", "extend", "insert", "remove",
+             "discard", "pop", "popitem", "clear", "setdefault",
+             "write", "inc", "dec", "set", "observe", "put"}
+
+
+def _is_jit_func(fn: ast.AST) -> bool:
+    """Does this callee expression denote a jit transform?"""
+    if isinstance(fn, ast.Attribute):
+        return fn.attr == "jit"
+    if isinstance(fn, ast.Name):
+        return fn.id == "jit"
+    if isinstance(fn, ast.Call):
+        # partial(jax.jit, ...) used as a decorator factory
+        inner = fn.func
+        if isinstance(inner, ast.Name) and inner.id == "partial" \
+                and fn.args:
+            return _is_jit_func(fn.args[0])
+    return False
+
+
+def _local_defs(scope: ast.AST) -> Dict[str, ast.AST]:
+    out = {}
+    for node in ast.walk(scope):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            out.setdefault(node.name, node)
+    return out
+
+
+def find_traced(tree: ast.AST) -> List[ast.AST]:
+    """Function/Lambda nodes that get jit-traced in this module."""
+    traced: List[ast.AST] = []
+    defs = _local_defs(tree)
+    seen: Set[int] = set()
+
+    def add(node: Optional[ast.AST]) -> None:
+        if node is not None and id(node) not in seen:
+            seen.add(id(node))
+            traced.append(node)
+
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for deco in node.decorator_list:
+                if _is_jit_func(deco):
+                    add(node)
+        if isinstance(node, ast.Call) and _is_jit_func(node.func) \
+                and node.args:
+            arg = node.args[0]
+            if isinstance(arg, ast.Lambda):
+                add(arg)
+            elif isinstance(arg, ast.Name) and arg.id in defs:
+                add(defs[arg.id])
+    return traced
+
+
+def _assigned_names(fn: ast.AST) -> Set[str]:
+    """Names local to the traced function: parameters + assignments +
+    comprehension targets + nested defs."""
+    names: Set[str] = set()
+    args = getattr(fn, "args", None)
+    if args is not None:
+        for a in list(args.args) + list(args.posonlyargs) \
+                + list(args.kwonlyargs):
+            names.add(a.arg)
+        if args.vararg:
+            names.add(args.vararg.arg)
+        if args.kwarg:
+            names.add(args.kwarg.arg)
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Name) and \
+                isinstance(node.ctx, (ast.Store, ast.Del)):
+            names.add(node.id)
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            names.add(node.name)
+            for a in node.args.args:
+                names.add(a.arg)
+    return names
+
+
+def _root_name(expr: ast.AST) -> Optional[str]:
+    while isinstance(expr, ast.Attribute):
+        expr = expr.value
+    return expr.id if isinstance(expr, ast.Name) else None
+
+
+def _check_traced(src, fn: ast.AST) -> List[Finding]:
+    findings: List[Finding] = []
+    local = _assigned_names(fn)
+    body = fn.body if isinstance(fn.body, list) else [fn.body]
+    # calls whose result is discarded (statement expressions): the
+    # in-place-mutator signature. A call whose return value is consumed
+    # (``updates, s = opt.update(...)``) is functional style — optax
+    # transforms are pure — and must not be flagged.
+    discarded: Set[int] = set()
+    for stmt in body:
+        for node in ast.walk(stmt):
+            if isinstance(node, ast.Expr) and \
+                    isinstance(node.value, ast.Call):
+                discarded.add(id(node.value))
+
+    def flag(line: int, what: str, why: str) -> None:
+        findings.append(Finding(
+            NAME, src.rel, line,
+            f"host side effect inside a jit-traced function: {what} — "
+            f"{why}"))
+
+    for stmt in body:
+        for node in ast.walk(stmt):
+            if isinstance(node, ast.Call):
+                cf = node.func
+                if isinstance(cf, ast.Attribute):
+                    root = _root_name(cf)
+                    if root in _IMPURE_MODULES:
+                        flag(node.lineno,
+                             f"{root}.{cf.attr}()",
+                             "evaluates once at trace time and freezes "
+                             "into the compiled program")
+                    elif root in _HOST_ARRAY_MODULES and \
+                            cf.attr != "dtype":
+                        flag(node.lineno,
+                             f"{root}.{cf.attr}()",
+                             "numpy executes on host at trace time — on "
+                             "a tracer this either errors or bakes in a "
+                             "stale constant; use jnp")
+                    elif isinstance(cf.value, ast.Name) and \
+                            cf.value.id == "os" and cf.attr == "getenv":
+                        flag(node.lineno, "os.getenv()",
+                             "environment reads freeze at trace time")
+                    elif cf.attr in _MUTATORS and id(node) in discarded:
+                        recv = _root_name(cf.value)
+                        if recv is not None and recv not in local:
+                            flag(node.lineno,
+                                 f"mutation of captured state "
+                                 f"{recv!r} via .{cf.attr}()",
+                                 "runs once per recompile, not once per "
+                                 "call — silent staleness")
+                elif isinstance(cf, ast.Name) and \
+                        cf.id in ("print", "open", "input"):
+                    flag(node.lineno, f"{cf.id}()",
+                         "host I/O executes at trace time only")
+            elif isinstance(node, ast.Attribute) and \
+                    isinstance(node.value, ast.Name) and \
+                    node.value.id == "os" and node.attr == "environ":
+                flag(node.lineno, "os.environ read",
+                     "environment reads freeze at trace time")
+            elif isinstance(node, (ast.Assign, ast.AugAssign)):
+                targets = node.targets if isinstance(node, ast.Assign) \
+                    else [node.target]
+                for tgt in targets:
+                    if isinstance(tgt, ast.Attribute):
+                        root = _root_name(tgt)
+                        if root is not None and root not in local:
+                            flag(node.lineno,
+                                 f"assignment to captured "
+                                 f"{ast.unparse(tgt)}",
+                                 "runs once per recompile, not once per "
+                                 "call — silent staleness")
+                    elif isinstance(tgt, ast.Subscript):
+                        root = _root_name(tgt.value)
+                        if root is not None and root not in local:
+                            flag(node.lineno,
+                                 f"item assignment into captured "
+                                 f"{root!r}",
+                                 "mutates host state at trace time only")
+            elif isinstance(node, ast.Global):
+                flag(node.lineno, "global statement",
+                     "rebinding module state from a traced body runs at "
+                     "trace time only")
+    return findings
+
+
+@checker(NAME)
+def run(ctx: Context) -> List[Finding]:
+    findings: List[Finding] = []
+    for src in ctx.package_files:
+        if src.tree is None:
+            continue
+        for fn in find_traced(src.tree):
+            findings.extend(_check_traced(src, fn))
+    return findings

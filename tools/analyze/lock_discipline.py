@@ -1,0 +1,332 @@
+"""``lock-discipline``: per-class lock/attribute guard inference.
+
+For every class in the package that *owns a thread* (it passes one of
+its methods as a ``threading.Thread``/``Timer`` target), this pass:
+
+1. finds its lock attributes (``self.X = threading.Lock()`` /
+   ``_locks.lock(...)``);
+2. infers which attributes each lock guards, from the attribute *writes*
+   that happen inside ``with self.X:`` bodies — including writes in
+   private helpers that are *only ever called with the lock held*
+   (``_journal_append_locked``-style), via a fixed-point propagation of
+   held-locks-at-entry over the intra-class call graph;
+3. flags every **write** to a guarded attribute performed without its
+   lock from a method reachable by more than one thread (everything
+   except ``__init__``/``__del__`` once the class starts a thread);
+4. flags **blocking calls** made while holding a lock: unbounded
+   ``.join()``, ``time.sleep``, ``urlopen``/``requests.*``, unbounded
+   ``.wait()``, and unbounded ``put``/``get`` on queue-shaped
+   attributes — each one is a lock-held stall that every other thread
+   inherits.
+
+Reads outside the lock are deliberately *not* flagged: benign racy reads
+of monotonic flags (``self._stopped``) are idiomatic shutdown fast-paths
+and flagging them would bury the real findings. The write rule plus the
+runtime sentinel (docs/static_analysis.md) cover the dangerous side.
+"""
+
+import ast
+from typing import Dict, FrozenSet, List, Optional, Set, Tuple
+
+from .core import Context, Finding, checker
+
+NAME = "lock-discipline"
+
+#: universe marker for the held-at-entry fixed point ("not yet narrowed")
+_U = None
+
+_LOCK_FACTORIES = {"Lock", "RLock", "lock", "rlock"}
+_CONSTRUCTOR_EXEMPT = {"__init__", "__del__"}
+_MUTATORS = {"append", "add", "update", "extend", "insert", "remove",
+             "discard", "pop", "popitem", "clear", "setdefault"}
+_QUEUE_ATTR_HINTS = ("queue", "_q")
+
+
+def _is_lock_ctor(value: ast.AST) -> bool:
+    if not isinstance(value, ast.Call):
+        return False
+    fn = value.func
+    if isinstance(fn, ast.Attribute):
+        return fn.attr in _LOCK_FACTORIES
+    if isinstance(fn, ast.Name):
+        return fn.id in _LOCK_FACTORIES
+    return False
+
+
+def _self_attr(node: ast.AST) -> Optional[str]:
+    if isinstance(node, ast.Attribute) and \
+            isinstance(node.value, ast.Name) and node.value.id == "self":
+        return node.attr
+    return None
+
+
+def _call_has_timeout(call: ast.Call) -> bool:
+    if any(kw.arg in ("timeout", "block") for kw in call.keywords):
+        return True
+    return bool(call.args)     # join(5) / wait(2.0) style positional bound
+
+
+class _Access:
+    __slots__ = ("attr", "line", "write", "held")
+
+    def __init__(self, attr: str, line: int, write: bool,
+                 held: FrozenSet[str]):
+        self.attr = attr
+        self.line = line
+        self.write = write
+        self.held = held
+
+
+class _Call:
+    __slots__ = ("name", "line", "held")
+
+    def __init__(self, name: str, line: int, held: FrozenSet[str]):
+        self.name = name
+        self.line = line
+        self.held = held
+
+
+class _Blocking:
+    __slots__ = ("desc", "line", "held")
+
+    def __init__(self, desc: str, line: int, held: FrozenSet[str]):
+        self.desc = desc
+        self.line = line
+        self.held = held
+
+
+class _MethodScan(ast.NodeVisitor):
+    """One pass over a method body tracking the lexically-held lock set."""
+
+    def __init__(self, lock_attrs: Set[str]):
+        self.lock_attrs = lock_attrs
+        self.held: Tuple[str, ...] = ()
+        self.accesses: List[_Access] = []
+        self.calls: List[_Call] = []
+        self.blocking: List[_Blocking] = []
+
+    # -- held tracking -------------------------------------------------------
+    def visit_With(self, node: ast.With) -> None:
+        acquired = []
+        for item in node.items:
+            attr = _self_attr(item.context_expr)
+            if attr in self.lock_attrs:
+                acquired.append(attr)
+        prev = self.held
+        self.held = prev + tuple(a for a in acquired if a not in prev)
+        for item in node.items:
+            self.visit(item.context_expr)
+        for stmt in node.body:
+            self.visit(stmt)
+        self.held = prev
+
+    # -- attribute accesses --------------------------------------------------
+    def _record(self, attr: Optional[str], line: int, write: bool) -> None:
+        if attr is not None and attr not in self.lock_attrs:
+            self.accesses.append(
+                _Access(attr, line, write, frozenset(self.held)))
+
+    def visit_Attribute(self, node: ast.Attribute) -> None:
+        attr = _self_attr(node)
+        if attr is not None:
+            self._record(attr, node.lineno,
+                         isinstance(node.ctx, (ast.Store, ast.Del)))
+        self.generic_visit(node)
+
+    def visit_Subscript(self, node: ast.Subscript) -> None:
+        # self.X[...] = v / del self.X[...] mutate the container X
+        if isinstance(node.ctx, (ast.Store, ast.Del)):
+            self._record(_self_attr(node.value), node.lineno, True)
+        self.generic_visit(node)
+
+    def visit_AugAssign(self, node: ast.AugAssign) -> None:
+        self._record(_self_attr(node.target), node.lineno, True)
+        if isinstance(node.target, ast.Subscript):
+            self._record(_self_attr(node.target.value), node.lineno, True)
+        self.generic_visit(node)
+
+    # -- calls ---------------------------------------------------------------
+    def visit_Call(self, node: ast.Call) -> None:
+        fn = node.func
+        if isinstance(fn, ast.Attribute):
+            recv_attr = _self_attr(fn.value)
+            if recv_attr is not None and fn.attr in _MUTATORS:
+                # self.X.append(...) mutates the container bound to X
+                self._record(recv_attr, node.lineno, True)
+            if isinstance(fn.value, ast.Name) and fn.value.id == "self":
+                self.calls.append(
+                    _Call(fn.attr, node.lineno, frozenset(self.held)))
+            self._check_blocking_attr(node, fn)
+        elif isinstance(fn, ast.Name):
+            if fn.id == "urlopen" and self.held:
+                self.blocking.append(_Blocking(
+                    "urlopen() (network round-trip)", node.lineno,
+                    frozenset(self.held)))
+        self.generic_visit(node)
+
+    def _check_blocking_attr(self, node: ast.Call,
+                             fn: ast.Attribute) -> None:
+        if not self.held:
+            return
+        held = frozenset(self.held)
+        if fn.attr == "join" and not _call_has_timeout(node):
+            self.blocking.append(_Blocking(
+                ".join() with no timeout", node.lineno, held))
+        elif fn.attr == "sleep" and isinstance(fn.value, ast.Name) \
+                and fn.value.id == "time":
+            self.blocking.append(_Blocking(
+                "time.sleep()", node.lineno, held))
+        elif fn.attr == "wait" and not _call_has_timeout(node):
+            self.blocking.append(_Blocking(
+                ".wait() with no timeout", node.lineno, held))
+        elif fn.attr == "urlopen" or (
+                isinstance(fn.value, ast.Name) and fn.value.id == "requests"):
+            self.blocking.append(_Blocking(
+                f"{fn.attr}() (network round-trip)", node.lineno, held))
+        elif fn.attr in ("put", "get") and not _call_has_timeout(node):
+            recv = fn.value
+            name = _self_attr(recv) or (
+                recv.id if isinstance(recv, ast.Name) else "")
+            if name and any(h in name.lower() for h in _QUEUE_ATTR_HINTS):
+                self.blocking.append(_Blocking(
+                    f"unbounded {name}.{fn.attr}()", node.lineno, held))
+
+
+def _thread_targets(cls: ast.ClassDef) -> Set[str]:
+    """Names of methods this class hands to a Thread/Timer — the extra
+    threads whose existence makes unguarded shared state a race."""
+    targets: Set[str] = set()
+    for node in ast.walk(cls):
+        if not isinstance(node, ast.Call):
+            continue
+        fn = node.func
+        ctor = fn.attr if isinstance(fn, ast.Attribute) else (
+            fn.id if isinstance(fn, ast.Name) else "")
+        if ctor not in ("Thread", "Timer"):
+            continue
+        cands = [kw.value for kw in node.keywords if kw.arg == "target"]
+        if ctor == "Timer" and len(node.args) >= 2:
+            cands.append(node.args[1])
+        for cand in cands:
+            attr = _self_attr(cand)
+            if attr is not None:
+                targets.add(attr)
+    return targets
+
+
+def _lock_attrs(cls: ast.ClassDef) -> Set[str]:
+    locks: Set[str] = set()
+    for node in ast.walk(cls):
+        if isinstance(node, ast.Assign) and _is_lock_ctor(node.value):
+            for tgt in node.targets:
+                attr = _self_attr(tgt)
+                if attr is not None:
+                    locks.add(attr)
+    return locks
+
+
+def _entry_held(methods: Dict[str, ast.FunctionDef],
+                scans: Dict[str, "_MethodScan"],
+                thread_targets: Set[str]) -> Dict[str, FrozenSet[str]]:
+    """Fixed point: locks guaranteed held at each method's entry.
+
+    Externally-reachable methods (public API, dunders, thread targets)
+    enter with nothing held. A private helper only ever invoked
+    intra-class enters with the intersection of (caller's entry set ∪
+    locks lexically held at the call site) over all its call sites —
+    the ``*_locked`` helper pattern."""
+    callers: Dict[str, List[Tuple[str, FrozenSet[str]]]] = {}
+    for m, scan in scans.items():
+        for call in scan.calls:
+            if call.name in methods:
+                callers.setdefault(call.name, []).append((m, call.held))
+    entry: Dict[str, object] = {}
+    for m in methods:
+        external = (not m.startswith("_")) or m.startswith("__") \
+            or m in thread_targets or m not in callers
+        entry[m] = frozenset() if external else _U
+    for _ in range(len(methods) + 1):
+        changed = False
+        for m in methods:
+            if m not in callers or entry[m] == frozenset():
+                continue
+            sites = []
+            for caller, site_held in callers[m]:
+                ce = entry[caller]
+                if ce is _U:
+                    continue        # not yet narrowed; skip this round
+                sites.append(frozenset(ce) | site_held)
+            if not sites:
+                continue
+            new = frozenset.intersection(*sites)
+            if entry[m] is _U or new != entry[m]:
+                entry[m] = new
+                changed = True
+        if not changed:
+            break
+    return {m: (frozenset() if e is _U else e) for m, e in entry.items()}
+
+
+@checker(NAME)
+def run(ctx: Context) -> List[Finding]:
+    findings: List[Finding] = []
+    for src in ctx.package_files:
+        if src.tree is None:
+            continue
+        for cls in [n for n in ast.walk(src.tree)
+                    if isinstance(n, ast.ClassDef)]:
+            findings.extend(_check_class(src, cls))
+    return findings
+
+
+def _check_class(src, cls: ast.ClassDef) -> List[Finding]:
+    targets = _thread_targets(cls)
+    if not targets:
+        return []                   # no thread of its own: out of scope
+    locks = _lock_attrs(cls)
+    methods = {n.name: n for n in cls.body
+               if isinstance(n, ast.FunctionDef)}
+    scans: Dict[str, _MethodScan] = {}
+    for name, node in methods.items():
+        scan = _MethodScan(locks)
+        for stmt in node.body:
+            scan.visit(stmt)
+        scans[name] = scan
+    entry = _entry_held(methods, scans, targets)
+
+    # effective held set per access/blocking record. Guard inference
+    # comes from WRITES under a lock only: an incidental read inside an
+    # unrelated locked region must not make the attribute look guarded
+    # (deliberately racy monotonic flags are read everywhere).
+    guarded_by: Dict[str, Set[str]] = {}
+    per_attr: List[Tuple[str, str, _Access, FrozenSet[str]]] = []
+    findings: List[Finding] = []
+    for m, scan in scans.items():
+        base = entry.get(m, frozenset())
+        for acc in scan.accesses:
+            held = acc.held | base
+            if held and acc.write and m not in _CONSTRUCTOR_EXEMPT:
+                guarded_by.setdefault(acc.attr, set()).update(held)
+            per_attr.append((m, acc.attr, acc, held))
+        for blk in scan.blocking:
+            held = blk.held | base
+            if held:
+                findings.append(Finding(
+                    NAME, src.rel, blk.line,
+                    f"{cls.name}.{m} makes a blocking call "
+                    f"({blk.desc}) while holding "
+                    f"{sorted(held)} — every thread contending on the "
+                    f"lock inherits the stall"))
+    for m, attr, acc, held in per_attr:
+        if not acc.write or m in _CONSTRUCTOR_EXEMPT:
+            continue
+        guards = guarded_by.get(attr, set())
+        if guards and not (held & guards):
+            findings.append(Finding(
+                NAME, src.rel, acc.line,
+                f"{cls.name}.{attr} is guarded by "
+                f"{sorted('self.' + g for g in guards)} elsewhere but "
+                f"written here without it ({cls.name}.{m}; class runs "
+                f"threads via {sorted(targets)}) — a concurrent "
+                f"writer/reader under the lock can race this write"))
+    return findings

@@ -1,0 +1,356 @@
+"""``lock-order``: global static lock-acquisition graph + cycle check.
+
+Nodes are lock *roles* — ``<module>.<Class>.<attr>`` for instance locks,
+``<module>.<name>`` for module-level locks. An edge ``A -> B`` means
+somewhere in the package a thread can acquire ``B`` while holding ``A``:
+
+* lexically (``with self._a: ... with self._b:``), or
+* through a call made with ``A`` held, to a callee that (transitively)
+  acquires ``B``. Calls are resolved intra-class (``self.m()``,
+  including single-module base classes), intra-module (bare names), and
+  cross-module through ``from .. import x as alias`` aliases — the
+  resolvable static slice of the global graph. Dynamic dispatch
+  (callbacks, metric cells) is the runtime sentinel's job
+  (``horovod_tpu/_locks.py``; docs/static_analysis.md).
+
+A cycle in this graph is a potential deadlock: two threads walking the
+cycle from different entry points can each hold what the other wants.
+Every cycle is reported with the provenance of each participating edge.
+Self-edges (two *instances* of one class nested) are skipped statically
+— instance identity is invisible to the AST — and left to the sentinel.
+"""
+
+import ast
+import os
+from typing import Dict, List, Optional, Set, Tuple
+
+from .core import Context, Finding, checker
+
+NAME = "lock-order"
+
+_LOCK_FACTORIES = {"Lock", "RLock", "lock", "rlock"}
+
+
+def _is_lock_ctor(value: ast.AST) -> bool:
+    if not isinstance(value, ast.Call):
+        return False
+    fn = value.func
+    name = fn.attr if isinstance(fn, ast.Attribute) else (
+        fn.id if isinstance(fn, ast.Name) else "")
+    return name in _LOCK_FACTORIES
+
+
+class _Module:
+    """Per-module symbol tables the resolver needs."""
+
+    def __init__(self, src, modname: str):
+        self.src = src
+        self.name = modname
+        self.classes: Dict[str, ast.ClassDef] = {}
+        self.functions: Dict[str, ast.FunctionDef] = {}
+        self.module_locks: Set[str] = set()
+        self.import_alias: Dict[str, str] = {}   # local name -> module
+        self.bases: Dict[str, List[str]] = {}    # class -> same-module bases
+        tree = src.tree
+        if tree is None:
+            return
+        for node in tree.body:
+            if isinstance(node, ast.ClassDef):
+                self.classes[node.name] = node
+                self.bases[node.name] = [
+                    b.id for b in node.bases if isinstance(b, ast.Name)]
+            elif isinstance(node, ast.FunctionDef):
+                self.functions[node.name] = node
+            elif isinstance(node, ast.Assign) and _is_lock_ctor(node.value):
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Name):
+                        self.module_locks.add(tgt.id)
+            elif isinstance(node, ast.ImportFrom):
+                base = _import_base(self.name, node)
+                for alias in node.names:
+                    local = alias.asname or alias.name
+                    target = f"{base}.{alias.name}" if base else alias.name
+                    self.import_alias[local] = target
+
+    def lock_attrs(self, cls: str) -> Set[str]:
+        out: Set[str] = set()
+        for c in self._mro(cls):
+            node = self.classes.get(c)
+            if node is None:
+                continue
+            for sub in ast.walk(node):
+                if isinstance(sub, ast.Assign) and _is_lock_ctor(sub.value):
+                    for tgt in sub.targets:
+                        if isinstance(tgt, ast.Attribute) and \
+                                isinstance(tgt.value, ast.Name) and \
+                                tgt.value.id == "self":
+                            out.add(tgt.attr)
+        return out
+
+    def _mro(self, cls: str) -> List[str]:
+        seen, order = set(), []
+        stack = [cls]
+        while stack:
+            c = stack.pop(0)
+            if c in seen or c not in self.classes:
+                continue
+            seen.add(c)
+            order.append(c)
+            stack.extend(self.bases.get(c, []))
+        return order
+
+    def find_method(self, cls: str, name: str
+                    ) -> Optional[Tuple[str, ast.FunctionDef]]:
+        for c in self._mro(cls):
+            node = self.classes.get(c)
+            if node is None:
+                continue
+            for sub in node.body:
+                if isinstance(sub, ast.FunctionDef) and sub.name == name:
+                    return c, sub
+        return None
+
+
+def _import_base(modname: str, node: ast.ImportFrom) -> str:
+    """horovod_tpu-relative dotted path of the package/module a
+    ``from X import Y`` pulls names out of. ``modname`` is the importing
+    module, package-relative (``runner.rendezvous``); a relative import
+    of level N resolves against its enclosing package."""
+    if node.level == 0:
+        mod = node.module or ""
+        return mod[len("horovod_tpu."):] if \
+            mod.startswith("horovod_tpu.") else mod
+    parts = modname.split(".") if modname else []
+    pkg = parts[: max(0, len(parts) - node.level)]
+    if node.module:
+        pkg = pkg + node.module.split(".")
+    return ".".join(pkg)
+
+
+class _FnScan(ast.NodeVisitor):
+    """Lexical acquisitions + call sites of one function/method body."""
+
+    def __init__(self, mod: _Module, cls: Optional[str]):
+        self.mod = mod
+        self.cls = cls
+        self.self_locks = mod.lock_attrs(cls) if cls else set()
+        self.held: Tuple[str, ...] = ()
+        #: (held_node, acquired_node, line) for lexical nesting
+        self.edges: List[Tuple[str, str, int]] = []
+        #: every lock node acquired lexically anywhere in the body
+        self.acquired: Set[str] = set()
+        #: (callee_key, held_nodes, line)
+        self.calls: List[Tuple[Tuple, Tuple[str, ...], int]] = []
+
+    def _lock_node(self, expr: ast.AST) -> Optional[str]:
+        if isinstance(expr, ast.Attribute) and \
+                isinstance(expr.value, ast.Name) and \
+                expr.value.id == "self" and expr.attr in self.self_locks:
+            return f"{self.mod.name}.{self.cls}.{expr.attr}"
+        if isinstance(expr, ast.Name) and \
+                expr.id in self.mod.module_locks:
+            return f"{self.mod.name}.{expr.id}"
+        return None
+
+    def visit_With(self, node: ast.With) -> None:
+        acquired = []
+        for item in node.items:
+            ln = self._lock_node(item.context_expr)
+            if ln is not None:
+                acquired.append(ln)
+        prev = self.held
+        for ln in acquired:
+            for held in self.held:
+                if held != ln:
+                    self.edges.append((held, ln, node.lineno))
+            self.acquired.add(ln)
+            self.held = self.held + (ln,)
+        for item in node.items:
+            self.visit(item.context_expr)
+        for stmt in node.body:
+            self.visit(stmt)
+        self.held = prev
+
+    def visit_Call(self, node: ast.Call) -> None:
+        fn = node.func
+        key = None
+        if isinstance(fn, ast.Name):
+            if fn.id in self.mod.functions:
+                key = ("func", self.mod.name, fn.id)
+        elif isinstance(fn, ast.Attribute) and isinstance(fn.value, ast.Name):
+            recv = fn.value.id
+            if recv == "self" and self.cls is not None:
+                key = ("method", self.mod.name, self.cls, fn.attr)
+            elif recv in self.mod.import_alias:
+                key = ("extfunc", self.mod.import_alias[recv], fn.attr)
+        if key is not None:
+            self.calls.append((key, self.held, node.lineno))
+        self.generic_visit(node)
+
+
+def _build(ctx: Context):
+    modules: Dict[str, _Module] = {}
+    for src in ctx.package_files:
+        if src.tree is None:
+            continue
+        modules[ctx.module_name(src)] = _Module(src, ctx.module_name(src))
+
+    scans: Dict[Tuple, Tuple[_Module, _FnScan]] = {}
+    for modname, mod in modules.items():
+        for fname, fnode in mod.functions.items():
+            scan = _FnScan(mod, None)
+            for stmt in fnode.body:
+                scan.visit(stmt)
+            scans[("func", modname, fname)] = (mod, scan)
+        for cname, cnode in mod.classes.items():
+            for sub in cnode.body:
+                if isinstance(sub, ast.FunctionDef):
+                    scan = _FnScan(mod, cname)
+                    for stmt in sub.body:
+                        scan.visit(stmt)
+                    scans[("method", modname, cname, sub.name)] = (mod, scan)
+    return modules, scans
+
+
+def _resolve(key: Tuple, modules: Dict[str, _Module],
+             scans: Dict) -> Optional[Tuple]:
+    """Normalize a call key to an existing scan key (or None)."""
+    if key in scans:
+        return key
+    if key and key[0] == "method":
+        _, modname, cls, name = key
+        mod = modules.get(modname)
+        if mod is not None:
+            found = mod.find_method(cls, name)
+            if found is not None:
+                return ("method", modname, found[0], name)
+    if key and key[0] == "extfunc":
+        _, target_mod, name = key
+        # the alias map stores package-relative paths; try as-is and with
+        # the horovod_tpu prefix stripped
+        for cand in (target_mod, target_mod.replace("horovod_tpu.", "", 1)):
+            k = ("func", cand, name)
+            if k in scans:
+                return k
+    return None
+
+
+def _transitive_acquired(scans, modules) -> Dict[Tuple, Set[str]]:
+    memo: Dict[Tuple, Set[str]] = {}
+
+    def go(key: Tuple, stack: Set[Tuple]) -> Set[str]:
+        if key in memo:
+            return memo[key]
+        if key in stack:
+            return set()
+        _mod, scan = scans[key]
+        stack = stack | {key}
+        out = set(scan.acquired)
+        for callee, _held, _line in scan.calls:
+            rk = _resolve(callee, modules, scans)
+            if rk is not None:
+                out |= go(rk, stack)
+        memo[key] = out
+        return out
+
+    for key in scans:
+        go(key, set())
+    return memo
+
+
+def build_graph(ctx: Context) -> Dict[Tuple[str, str], str]:
+    """(A, B) -> provenance for every observed may-acquire-B-holding-A."""
+    modules, scans = _build(ctx)
+    acq = _transitive_acquired(scans, modules)
+    edges: Dict[Tuple[str, str], str] = {}
+    for key, (mod, scan) in scans.items():
+        rel = mod.src.rel
+        for a, b, line in scan.edges:
+            edges.setdefault((a, b), f"{rel}:{line}")
+        for callee, held, line in scan.calls:
+            if not held:
+                continue
+            rk = _resolve(callee, modules, scans)
+            if rk is None:
+                continue
+            for b in acq.get(rk, ()):
+                for a in held:
+                    if a != b:
+                        edges.setdefault(
+                            (a, b),
+                            f"{rel}:{line} (via call to {callee[-1]})")
+    return edges
+
+
+def _cycles(edges: Dict[Tuple[str, str], str]) -> List[List[str]]:
+    graph: Dict[str, Set[str]] = {}
+    for a, b in edges:
+        graph.setdefault(a, set()).add(b)
+        graph.setdefault(b, set())
+    # Tarjan SCC
+    index: Dict[str, int] = {}
+    low: Dict[str, int] = {}
+    onstack: Set[str] = set()
+    stack: List[str] = []
+    sccs: List[List[str]] = []
+    counter = [0]
+
+    def strongconnect(v: str) -> None:
+        work = [(v, iter(sorted(graph[v])))]
+        index[v] = low[v] = counter[0]
+        counter[0] += 1
+        stack.append(v)
+        onstack.add(v)
+        while work:
+            node, it = work[-1]
+            advanced = False
+            for w in it:
+                if w not in index:
+                    index[w] = low[w] = counter[0]
+                    counter[0] += 1
+                    stack.append(w)
+                    onstack.add(w)
+                    work.append((w, iter(sorted(graph[w]))))
+                    advanced = True
+                    break
+                if w in onstack:
+                    low[node] = min(low[node], index[w])
+            if advanced:
+                continue
+            work.pop()
+            if work:
+                parent = work[-1][0]
+                low[parent] = min(low[parent], low[node])
+            if low[node] == index[node]:
+                comp = []
+                while True:
+                    w = stack.pop()
+                    onstack.discard(w)
+                    comp.append(w)
+                    if w == node:
+                        break
+                if len(comp) > 1:
+                    sccs.append(sorted(comp))
+
+    for v in sorted(graph):
+        if v not in index:
+            strongconnect(v)
+    return sccs
+
+
+@checker(NAME)
+def run(ctx: Context) -> List[Finding]:
+    edges = build_graph(ctx)
+    findings: List[Finding] = []
+    for comp in _cycles(edges):
+        inside = sorted((a, b) for (a, b) in edges
+                        if a in comp and b in comp)
+        detail = "; ".join(
+            f"{a} -> {b} at {edges[(a, b)]}" for a, b in inside)
+        first = edges[inside[0]].split(" ")[0]
+        path, _, line = first.partition(":")
+        findings.append(Finding(
+            NAME, path, int(line.split(":")[0] or 1),
+            f"lock-order cycle among {comp} (potential deadlock): "
+            f"{detail}"))
+    return findings

@@ -1,104 +1,22 @@
 #!/usr/bin/env python
-"""CI lint: every ``HVD_TPU_*`` environment variable referenced anywhere
-in the ``horovod_tpu`` package must be (a) registered in the knob
-registry (``horovod_tpu/config.py``) and (b) documented in
-``docs/configuration.md`` — and every registered knob must be documented.
-
-Rationale: the three-layer config contract (env <- CLI <- YAML) only
-holds if the registry is the single source of truth. A knob read with a
-bare ``os.environ.get("HVD_TPU_...")`` silently escapes CLI flags, YAML
-config, provenance reporting (``config.describe()``) and the docs table.
-This lint turns that drift into a CI failure.
-
-Vars that are deliberately NOT knobs (internal launcher->worker contract
-values the launcher computes and exports, or pre-registry bootstrap
-reads) are allowlisted below with their reason.
+"""Thin shim: the knob lint now lives in the unified static-analysis
+framework as the ``knobs`` checker (``tools/analyze/knobs.py``; run
+``python -m tools.analyze`` for the full suite). This path is kept so
+the ``lint-knobs`` CI suite, docs references, and any operator muscle
+memory keep working unchanged.
 
 Usage: ``python tools/check_knobs.py`` — exits 0 when clean, 1 with a
-report otherwise.
+report otherwise (the historical interface).
 """
 
 import os
-import re
 import sys
 
-REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-PACKAGE = os.path.join(REPO, "horovod_tpu")
-DOCS = os.path.join(REPO, "docs", "configuration.md")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-#: internal contract / bootstrap vars: read by the package but not user
-#: knobs, each with the reason it is exempt from registration
-ALLOWLIST = {
-    # launcher->worker elastic contract (computed per job, never user-set
-    # as a tuning knob; ELASTIC_STATE_DIR is honored if pre-set but its
-    # lifecycle is owned by the launcher)
-    "HVD_TPU_RESTART_STATE_FILE": "re-exec handoff file, set by reset()",
-    "HVD_TPU_ELASTIC_STATE_DIR": "durable-commit dir, launcher-managed",
-    "HVD_TPU_ELASTIC_JOB_ID": "job-unique token, launcher-generated",
-    # pre-registry bootstrap: resolved before/without any Config instance
-    "HVD_TPU_NATIVE": "gates the native build before config can load",
-    "HVD_TPU_JOB_SEED": "mpirun wrapper job token, launcher-internal",
-}
-
-#: prefix families exempt wholesale (self-contained harness contracts)
-ALLOW_PREFIXES = (
-    "HVD_TPU_BENCH_",   # bench.py harness, not a runtime subsystem
-    "HVD_TPU_FAULT_SPEC_",  # (reserved)
-)
-
-_VAR = re.compile(r"HVD_TPU_[A-Z0-9_]+")
-
-
-def referenced_vars(root: str = PACKAGE):
-    """{var: [file:line, ...]} for every HVD_TPU_* literal in the package
-    (config.py excluded — it composes names from the registry)."""
-    refs = {}
-    for dirpath, _dirs, files in os.walk(root):
-        for fname in files:
-            if not fname.endswith(".py"):
-                continue
-            path = os.path.join(dirpath, fname)
-            if os.path.relpath(path, root) == "config.py":
-                continue
-            with open(path, encoding="utf-8") as f:
-                for lineno, line in enumerate(f, 1):
-                    for m in _VAR.finditer(line):
-                        refs.setdefault(m.group(0), []).append(
-                            f"{os.path.relpath(path, REPO)}:{lineno}")
-    return refs
-
-
-def registered_vars():
-    sys.path.insert(0, REPO)
-    from horovod_tpu import config
-    return {"HVD_TPU_" + k for k in config.knobs()}
-
-
-def documented_vars(path: str = DOCS):
-    with open(path, encoding="utf-8") as f:
-        return set(_VAR.findall(f.read()))
-
-
-def check():
-    """Returns a list of violation strings (empty = clean)."""
-    refs = referenced_vars()
-    registered = registered_vars()
-    documented = documented_vars()
-    problems = []
-    for var in sorted(refs):
-        if var in ALLOWLIST or var.startswith(ALLOW_PREFIXES):
-            continue
-        if var not in registered:
-            where = ", ".join(refs[var][:3])
-            problems.append(
-                f"{var}: referenced ({where}) but not registered in "
-                f"horovod_tpu/config.py — register it or allowlist it in "
-                f"tools/check_knobs.py with a reason")
-    for var in sorted(registered - documented):
-        problems.append(
-            f"{var}: registered in config.py but missing from "
-            f"docs/configuration.md — add a table row")
-    return problems
+from tools.analyze.knobs import (  # noqa: E402,F401 — re-exported API
+    ALLOW_PREFIXES, ALLOWLIST, check, documented_vars, referenced_vars,
+    registered_vars)
 
 
 def main() -> int:
