@@ -41,6 +41,14 @@ kill window, whatever that window is):
      note cites BENCH_TPU_LAST.json, a TRACKED artifact updated with
      every live accelerator best line, so a flaky relay at scoring time
      never erases in-round hardware evidence.
+  6. Fallbacks are LOUD (BENCH_r03-r05 regression-blindness fix): a probe
+     that comes up on CPU while a TPU was requested (non-CPU
+     JAX_PLATFORMS, a configured PJRT relay, or HVD_TPU_BENCH_REQUIRE_TPU
+     =1) counts as a failed attempt and keeps retrying; every JSON line
+     carries first-class "platform" and "cpu_fallback" fields; and when a
+     TPU-requested run still ends on a CPU (or no) number, the process
+     exits nonzero so the driver can never mistake a fallback for a
+     healthy round.
 """
 
 import json
@@ -104,6 +112,29 @@ def _log(msg):
     sys.stderr.flush()
 
 
+def _tpu_requested() -> bool:
+    """True when this run is expected to land on an accelerator: explicit
+    ``HVD_TPU_BENCH_REQUIRE_TPU=1``, a non-CPU ``JAX_PLATFORMS``, or the
+    axon PJRT relay being configured. BENCH_r03-r05 all fell back to CPU
+    *silently* (the probe accepted a cpu backend as success), hiding TPU
+    regressions since 2404 img/s/chip — when a TPU was requested, falling
+    back must be loud: stamped in the JSON and a nonzero exit."""
+    req = os.environ.get("HVD_TPU_BENCH_REQUIRE_TPU")
+    if req is not None:
+        return req.strip().lower() not in ("", "0", "false", "no")
+    plats = os.environ.get("JAX_PLATFORMS", "").strip().lower()
+    if plats and plats != "cpu":
+        return True
+    return bool(os.environ.get("PALLAS_AXON_POOL_IPS"))
+
+
+def _fell_back(d) -> bool:
+    """Did this result line come from anything other than a live
+    accelerator?"""
+    return d is None or d.get("cpu_fallback") \
+        or d.get("backend") in ("none", "cpu", "cpu_fallback")
+
+
 def _remaining():
     return DEADLINE - time.time()
 
@@ -125,9 +156,9 @@ def _emit_best_and_exit(signum=None, frame=None):
     else:
         _emit({"metric": "resnet50_synthetic_images_per_sec_per_chip",
                "value": 0.0, "unit": "images/sec/chip", "vs_baseline": 0.0,
-               "backend": "none",
+               "backend": "none", "platform": "none", "cpu_fallback": True,
                "note": f"killed (sig={signum}) before any stage completed"})
-    os._exit(0)
+    os._exit(1 if _tpu_requested() and _fell_back(_best) else 0)
 
 
 def probe_backend():
@@ -168,17 +199,30 @@ def probe_backend():
             _log(last_err)
             p = None
         if p is not None:
-            for line in (p.stdout or "").splitlines():
-                if line.startswith("PROBE_OK|"):
-                    _, platform, kind, n = line.strip().split("|")
+            ok = next((line for line in (p.stdout or "").splitlines()
+                       if line.startswith("PROBE_OK|")), None)
+            if ok is not None:
+                _, platform, kind, n = ok.strip().split("|")
+                if platform == "cpu" and _tpu_requested():
+                    # jax came up, but on CPU while a TPU was requested:
+                    # the plugin/relay failed to attach. The old probe
+                    # accepted this as success and the run silently fell
+                    # back (BENCH_r03-r05) — treat it as a FAILED attempt
+                    # and keep retrying until the CPU reserve.
+                    last_err = (f"probe attempt {attempt}: backend came "
+                                f"up as cpu while a TPU was requested "
+                                f"(accelerator plugin not attached)")
+                    _log(last_err)
+                else:
                     _log(f"backend up in {time.time() - t0:.1f}s "
                          f"(attempt {attempt}): {platform} / {kind} x{n}")
                     return ({"platform": platform, "device_kind": kind,
                              "num_devices": int(n)}, last_err)
-            tail = (p.stderr or p.stdout or "").strip().splitlines()[-6:]
-            last_err = (f"probe attempt {attempt}: rc={p.returncode}: "
-                        + " | ".join(t.strip() for t in tail))
-            _log(last_err)
+            else:
+                tail = (p.stderr or p.stdout or "").strip().splitlines()[-6:]
+                last_err = (f"probe attempt {attempt}: rc={p.returncode}: "
+                            + " | ".join(t.strip() for t in tail))
+                _log(last_err)
         # Back off before the next try, but never sleep past the point
         # where another probe would no longer fit before the CPU reserve.
         if _remaining() > CPU_RESERVE_S + backoff + 15:
@@ -186,11 +230,18 @@ def probe_backend():
             backoff = min(backoff * 2, 30)
 
 
-def _result_json(r, backend_label, note=""):
+def _result_json(r, backend_label, note="", platform=None):
+    # platform + cpu_fallback ride every line, up front: BENCH_r03-r05
+    # were only diagnosable by cross-referencing the note text — the
+    # fallback state must be a first-class field a dashboard can key on.
     out = {
         "metric": "resnet50_synthetic_images_per_sec_per_chip",
         "value": round(r.images_per_sec_per_chip, 2),
         "unit": "images/sec/chip",
+        "platform": platform or (
+            "cpu" if backend_label in ("cpu_fallback", "cpu")
+            else backend_label),
+        "cpu_fallback": backend_label == "cpu_fallback",
         "vs_baseline": round(
             r.images_per_sec_per_chip / REFERENCE_IMG_PER_SEC_PER_CHIP, 3),
         "num_chips": r.num_chips,
@@ -227,7 +278,8 @@ def worker_main(cpu: bool, batch_override=None):
     if not hvd.is_initialized():
         hvd.init()
     import jax
-    backend_label = "cpu_fallback" if cpu else jax.devices()[0].platform
+    platform = jax.devices()[0].platform
+    backend_label = "cpu_fallback" if cpu else platform
 
     if cpu:
         stages = [
@@ -313,7 +365,7 @@ def worker_main(cpu: bool, batch_override=None):
              f"in {time.time() - t0:.0f}s")
         if r.images_per_sec_per_chip > best_v:
             best_v = r.images_per_sec_per_chip
-            _emit(_result_json(r, backend_label, note))
+            _emit(_result_json(r, backend_label, note, platform=platform))
     return 0
 
 
@@ -395,7 +447,7 @@ def main():
             cmd.append(f"--batch={batch}")
         if _stream_worker(cmd, env, "accelerator"):
             _emit(_best)  # authoritative final line = best stage
-            return 0
+            return 1 if _tpu_requested() and _fell_back(_best) else 0
         probe_err = probe_err or "accelerator worker produced no result"
     elif info:
         _log("default backend is CPU; using reduced CPU workload")
@@ -425,14 +477,17 @@ def main():
         if _stream_worker([sys.executable, me, "--worker", "--cpu"],
                           env, "cpu_fallback"):
             _emit(_best)
-            return 0
+            # A TPU was requested but this run's number is a CPU one:
+            # exit nonzero so the driver records the round as degraded
+            # instead of silently comparing CPU against TPU history.
+            return 1 if _tpu_requested() and _fell_back(_best) else 0
 
     _emit(_best or {
         "metric": "resnet50_synthetic_images_per_sec_per_chip",
         "value": 0.0, "unit": "images/sec/chip", "vs_baseline": 0.0,
-        "backend": "none",
+        "backend": "none", "platform": "none", "cpu_fallback": True,
         "note": f"all paths failed; last error: {probe_err}"[:1000]})
-    return 0
+    return 1 if _tpu_requested() and _fell_back(_best) else 0
 
 
 if __name__ == "__main__":

@@ -398,6 +398,62 @@ def dp_size() -> int:
     return _jax().device_count()
 
 
+def mapped_axis_sizes() -> dict:
+    """``{axis_name: size}`` for every named mesh axis mapped over the
+    *current trace* (shard_map/pmap scope). Empty when called eagerly or
+    under plain jit with no mapped axis — the signal the in-jit
+    collective fast path (collectives.py, docs/injit.md) keys on.
+
+    The axis environment moved between jax releases, so resolution is a
+    fallback chain: public ``jax.core.get_axis_env`` where it exists,
+    the private ``jax._src.core`` equivalent otherwise, and finally
+    ``unsafe_get_axis_names`` + per-axis ``axis_frame`` (which returns
+    the frame's size) for very old trees.
+    """
+    jax = _jax()
+    get_env = getattr(jax.core, "get_axis_env", None)
+    if get_env is None:
+        try:
+            from jax._src import core as _src_core
+            get_env = getattr(_src_core, "get_axis_env", None)
+        except ImportError:  # pragma: no cover - jax always has _src.core
+            get_env = None
+    if get_env is not None:
+        try:
+            return dict(get_env().axis_sizes)
+        except Exception:
+            pass
+    try:
+        from jax._src.core import unsafe_get_axis_names
+        names = list(unsafe_get_axis_names())
+    except Exception as e:
+        # No resolution path left on this jax. Returning {} here would
+        # make the in-jit fast path lower every collective with size-1
+        # (no-op) semantics — silently unreduced gradients. Fail loudly
+        # instead: HVD_TPU_INJIT_FASTPATH=0 routes callers back to the
+        # eager dispatcher until the axis-env resolution is re-taught.
+        raise RuntimeError(
+            "cannot introspect the jax axis environment on this jax "
+            "version (get_axis_env / unsafe_get_axis_names both "
+            "unavailable), so mapped axes are indistinguishable from "
+            "plain jit. Set HVD_TPU_INJIT_FASTPATH=0 to use the eager "
+            "dispatcher, or extend mapped_axis_sizes() for this jax "
+            "(docs/injit.md).") from e
+    out = {}
+    for n in names:
+        try:
+            out[n] = int(jax.core.axis_frame(n))
+        except Exception:
+            out[n] = 1
+    return out
+
+
+def mapped_axes() -> "tuple":
+    """Names of the mapped mesh axes in scope for the current trace,
+    outermost first (empty eagerly / under unmapped jit)."""
+    return tuple(mapped_axis_sizes())
+
+
 def is_homogeneous() -> bool:
     """True when every process has the same number of local devices
     (reference: mpi_controller.cc:25-81 homogeneity check)."""

@@ -6,12 +6,42 @@ MXU-native), so ``Compression.fp16`` here maps to bfloat16 by default with an
 ``fp16`` literal variant for exact reference parity. The eager allreduce
 accumulates half-precision inputs in fp32 (collectives.py), matching the
 reference's fp16 sum correctness concern (common/half.{h,cc}).
+
+Two planes consume this module:
+
+* **Eager** (``DistributedOptimizer`` mode 3): ``compress``/``decompress``
+  bracket the fused eager allreduce per tensor — the reference's exact
+  shape.
+* **Compiled packed** (docs/injit.md): the optimizer's packed fusion
+  buffers consult the class-level wire metadata instead of calling
+  ``compress`` — ``wire_dtype`` is what the flat bucket is cast to
+  *before* the XLA collective, and ``sum_safe_wire`` says whether
+  Sum/Average may accumulate in that dtype on the wire. bfloat16 carries
+  fp32's exponent range, so sums cannot overflow and the wire stays
+  half; IEEE fp16's 5-bit exponent overflows under Sum at scale, so the
+  fp16 packed path upcasts to fp32 for the collective (upcast-psum:
+  correctness over wire bytes — the reference's half.{h,cc} concern,
+  resolved the opposite way because XLA gives us the cast for free).
+* **int8** (:class:`Int8Compressor`) is compiled-packed only: per-bucket
+  shared scale (pmax of local absmax, so every rank dequantizes
+  identically) plus an error-feedback residual the optimizer carries as
+  optax state — :func:`int8_pack_reduce` is the traced kernel.
 """
 
 
 class Compressor:
     """Interface: compress(tensor) -> (compressed, ctx);
-    decompress(compressed, ctx) -> tensor."""
+    decompress(compressed, ctx) -> tensor.
+
+    Class-level wire metadata drives the compiled packed path
+    (optimizer.py): ``wire_dtype`` (None = native dtype on the wire),
+    ``sum_safe_wire`` (False = Sum/Average must upcast-psum to fp32),
+    ``stateful`` (True = needs an error-feedback residual carried as
+    optax state)."""
+
+    wire_dtype = None
+    sum_safe_wire = True
+    stateful = False
 
     @staticmethod
     def compress(tensor):
@@ -54,26 +84,108 @@ class _HalfCompressor(Compressor):
 
 
 class BF16Compressor(_HalfCompressor):
-    """Compress float gradients to bfloat16 for the wire (TPU-native half)."""
+    """Compress float gradients to bfloat16 for the wire (TPU-native half).
+
+    Packed in-jit: the flat bucket is cast to bf16 before the collective
+    and the psum runs IN bf16 — wire bytes halve. bf16 shares fp32's
+    exponent range, so the sum cannot overflow (``sum_safe_wire``)."""
 
 
 class FP16Compressor(_HalfCompressor):
-    """Compress float gradients to float16 (exact reference parity)."""
+    """Compress float gradients to float16 (exact reference parity).
+
+    Packed in-jit: values are rounded to fp16 (the compression), but
+    Sum/Average accumulate via upcast-psum in fp32 — fp16's narrow
+    exponent overflows under cross-replica sums (the reference's
+    half.{h,cc} concern), so this variant trades the wire win for
+    correctness. Use bf16 when the wire is what matters."""
+
+    sum_safe_wire = False
+
+
+class Int8Compressor(Compressor):
+    """Per-bucket symmetric int8 quantization with error feedback —
+    compiled packed path ONLY (docs/injit.md).
+
+    Every rank computes its bucket's absmax, takes the cross-replica max
+    (``lax.pmax``) so the scale is identical everywhere, quantizes to
+    int8, and the wire carries int8 via all-gather with exact int32
+    accumulation on-device (4x fewer wire bytes than fp32; summing int8
+    directly would overflow at >=2 ranks). The local quantization error
+    is fed back into the next step's gradient (error-feedback SGD), which
+    is what makes 8-bit training converge — the residual rides as optax
+    state on :class:`~horovod_tpu.optimizer.DistributedGradientTransform`.
+
+    The eager ``compress``/``decompress`` interface is deliberately
+    unimplemented: eager ranks quantizing with rank-local scales cannot
+    be summed meaningfully, and a per-call scale exchange would cost more
+    than the bytes it saves. Use ``axis_name=... , packing='packed'``.
+    """
+
+    stateful = True
+
+    @staticmethod
+    def compress(tensor):
+        raise NotImplementedError(
+            "Compression.int8 is a compiled-plane wire compressor: use "
+            "DistributedOptimizer(axis_name=..., packing='packed', "
+            "compression=Compression.int8) so the shared per-bucket "
+            "scale and error-feedback state exist (docs/injit.md).")
+
+    decompress = compress
+
+
+def int8_pack_reduce(flat, residual, axes, average: bool):
+    """Traced kernel for one int8 bucket: error feedback -> shared scale
+    (pmax) -> int8 quantize -> all-gather int8 wire -> exact int32 sum ->
+    dequantize fp32. Returns ``(reduced_fp32, new_residual_fp32)``.
+
+    ``axes`` is the mapped-axis name (or tuple of names) to reduce over;
+    empty/None means size-1 semantics (quantize+dequantize locally, so
+    the residual is still exercised). ``average`` divides by the world
+    size after the exact integer sum.
+    """
+    import jax
+    import jax.numpy as jnp
+    lax = jax.lax
+
+    x = flat.astype(jnp.float32)
+    if residual is not None:
+        x = x + residual.astype(jnp.float32)
+    absmax = jnp.max(jnp.abs(x))
+    if axes:
+        absmax = lax.pmax(absmax, axes)
+    scale = jnp.maximum(absmax / 127.0, jnp.finfo(jnp.float32).tiny)
+    q = jnp.clip(jnp.round(x / scale), -127.0, 127.0).astype(jnp.int8)
+    new_residual = x - q.astype(jnp.float32) * scale
+    if axes:
+        gathered = lax.all_gather(q, axes, axis=0, tiled=False)
+        summed = jnp.sum(gathered.astype(jnp.int32), axis=0)
+        n = gathered.shape[0]
+    else:
+        summed = q.astype(jnp.int32)
+        n = 1
+    out = summed.astype(jnp.float32) * scale
+    if average and n > 1:
+        out = out / float(n)
+    return out, new_residual
 
 
 def _bind_targets():
     import jax.numpy as jnp
-    BF16Compressor.target = jnp.bfloat16
-    FP16Compressor.target = jnp.float16
+    BF16Compressor.target = BF16Compressor.wire_dtype = jnp.bfloat16
+    FP16Compressor.target = FP16Compressor.wire_dtype = jnp.float16
 
 
 class Compression:
     """Optional gradient compression algorithms (reference API:
-    hvd.Compression.none / hvd.Compression.fp16)."""
+    hvd.Compression.none / hvd.Compression.fp16; int8 is the packed
+    compiled-plane extension, docs/injit.md)."""
     none = NoneCompressor
     fp16 = BF16Compressor       # TPU-native half: bfloat16
     fp16_strict = FP16Compressor  # literal IEEE fp16
     bf16 = BF16Compressor
+    int8 = Int8Compressor
 
 
 _bind_targets()

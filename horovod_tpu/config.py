@@ -72,6 +72,25 @@ PROGRAM_CACHE_CAPACITY = _register(
          "0 = unbounded). Distinct from CACHE_CAPACITY: program entries "
          "pin XLA executables and evictions cost a recompile on next "
          "use, so the two caches want very different capacities.")
+INJIT_FASTPATH = _register(
+    "INJIT_FASTPATH", True, _parse_bool,
+    help="Trace-aware collective lowering: an eager collective verb "
+         "(allreduce/grouped_allreduce/allgather/broadcast) called with "
+         "JAX tracers — i.e. from code already under jit/shard_map — "
+         "lowers directly to the XLA collective over the mapped axes in "
+         "scope instead of round-tripping the host dispatcher (zero "
+         "dispatcher hops, zero host staging, no consistency exchange: "
+         "the compiled SPMD program is the agreement). Set 0 to make "
+         "tracer inputs a hard error instead (docs/injit.md).")
+INJIT_PACKED_THRESHOLD = _register(
+    "INJIT_PACKED_THRESHOLD", 64 * 1024 * 1024, int,
+    help="Bucket cap in bytes for the in-jit packed fusion buffers "
+         "(DistributedOptimizer packing='packed'): gradient leaves are "
+         "concatenated per dtype into flat buffers of at most this many "
+         "bytes, one XLA collective per buffer — the compiled-plane "
+         "analogue of the reference's 64 MB fusion buffer "
+         "(fusion_buffer_manager.h:30-55). 0 packs each dtype into a "
+         "single unbounded buffer.")
 
 # -- Logging / timeline (reference: HOROVOD_LOG_LEVEL, HOROVOD_TIMELINE,
 #    HOROVOD_TIMELINE_MARK_CYCLES, common.h:61-63) ---------------------------

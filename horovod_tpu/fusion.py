@@ -9,14 +9,58 @@ how many XLA collective programs are launched per step and how much overlap
 is possible. Buckets are formed deterministically from traversal order, so
 every process builds identical buckets without negotiation (the compiled-SPMD
 replacement for the rank-0 negotiation protocol, SURVEY.md §5).
+
+Two consumers share the planner:
+
+* the eager plane (:func:`bucketed_apply`) — one *dispatch* per bucket,
+  dtype mixing allowed because the fused dispatch is a jit call, not a
+  flat buffer;
+* the compiled plane (:func:`packed_plan`, docs/injit.md) — one *flat
+  buffer* per bucket, so buckets are additionally split by dtype (a flat
+  buffer has exactly one dtype, like the reference's per-dtype fusion
+  buffers, fusion_buffer_manager.h:30-55).
+
+Both plans depend only on ``(shapes, dtypes, threshold)``, which is
+identical every training step, so they are memoized: the round-6 profile
+showed per-call metadata walks costing a steady-state grouped dispatch
+~2.5x a single allreduce's host work at 1 KiB payloads.
 """
 
 import ctypes
+from functools import lru_cache
 from typing import Any, Callable, List, Optional, Sequence, Tuple
 
 import numpy as np
 
 from ._native import get as _native_get
+
+_JNP = None
+_CANON = None
+
+
+def _jnp():
+    """Cached ``jax.numpy`` accessor (import hoisted out of the per-call
+    path; module-level import would make ``import horovod_tpu.fusion``
+    pull jax, which the planner itself never needs)."""
+    global _JNP
+    if _JNP is None:
+        import jax.numpy as jnp
+        _JNP = jnp
+    return _JNP
+
+
+def _canonical_dtype(v) -> "np.dtype":
+    """The dtype jax would give ``v`` when staged (x64-aware), without
+    building an array: ``jnp.asarray(v).dtype`` cost one device-transfer
+    candidate per leaf per call before the round-7 hoist."""
+    global _CANON
+    if _CANON is None:
+        from jax.dtypes import canonicalize_dtype
+        _CANON = canonicalize_dtype
+    dt = getattr(v, "dtype", None)
+    if dt is None:
+        dt = np.result_type(v)
+    return _CANON(dt)
 
 
 def plan_buckets(shapes_dtypes: Sequence[Tuple[tuple, Any]],
@@ -60,14 +104,125 @@ def plan_buckets(shapes_dtypes: Sequence[Tuple[tuple, Any]],
     return buckets
 
 
+@lru_cache(maxsize=512)
+def _plan_buckets_cached(shapes: tuple, dtypes: tuple,
+                         threshold_bytes: int) -> tuple:
+    metas = list(zip(shapes, dtypes))
+    return tuple(tuple(b) for b in plan_buckets(metas, threshold_bytes))
+
+
+@lru_cache(maxsize=512)
+def _packed_plan_cached(shapes: tuple, dtypes: tuple,
+                        threshold_bytes: int) -> tuple:
+    by_dtype = {}
+    for i, dt in enumerate(dtypes):
+        by_dtype.setdefault(dt, []).append(i)
+    plan = []
+    for dt in sorted(by_dtype):
+        idxs = by_dtype[dt]
+        if threshold_bytes <= 0:
+            # 0 = one unbounded flat buffer per dtype (the knob's
+            # documented semantics — distinct from the eager plane's
+            # "threshold 0 disables fusion", because here the whole point
+            # is the single packed collective)
+            plan.append((dt, tuple(idxs)))
+            continue
+        metas = [(shapes[i], dt) for i in idxs]
+        for b in plan_buckets(metas, threshold_bytes):
+            plan.append((dt, tuple(idxs[j] for j in b)))
+    return tuple(plan)
+
+
+def packed_plan(shapes: Sequence[tuple], dtypes: Sequence[Any],
+                threshold_bytes: int) -> tuple:
+    """Bucket plan for the compiled-plane packed fusion buffers
+    (docs/injit.md): leaves grouped by dtype (a flat buffer has one
+    dtype), each dtype group split by the greedy planner at
+    ``threshold_bytes`` (``HVD_TPU_INJIT_PACKED_THRESHOLD``; <= 0 packs
+    each dtype into a single unbounded buffer).
+
+    Returns ``((dtype_str, (leaf_index, ...)), ...)``. Memoized on
+    ``(shapes, dtypes, threshold)`` — the trace-time cost is paid once
+    per compilation signature, not once per trace.
+    """
+    return _packed_plan_cached(
+        tuple(tuple(s) for s in shapes),
+        tuple(str(d) for d in dtypes),
+        int(threshold_bytes))
+
+
+def packed_apply(leaves: Sequence, threshold_bytes: int,
+                 reduce_bucket: Callable,
+                 residuals: Optional[Sequence] = None):
+    """Trace-time fusion buffers: group same-dtype ``leaves`` into
+    :func:`packed_plan` buckets and call
+    ``reduce_bucket(bucket_leaves, bucket_residuals) ->
+    (out_leaves, new_residuals | None)`` ONCE per bucket — the reducer
+    issues ONE collective for the whole bucket (XLA's all-reduce is
+    variadic, so a bucket lowers to a single fused collective with the
+    runtime doing the buffer packing — fusion_buffer_manager.h:30-55
+    moved into the backend; quantizing reducers concatenate explicitly
+    instead, :func:`flatten_bucket`, because a shared per-bucket scale
+    needs one flat view).
+
+    ``residuals`` (optional, same length as ``leaves``) ride the same
+    buckets — the error-feedback state of the int8 wire compressor
+    (compression.py). Returns ``(out_leaves, new_residual_leaves)``; the
+    residual list is all-None when ``residuals`` is None or the reducer
+    returns no residuals.
+    """
+    jnp = _jnp()
+    shapes = [tuple(np.shape(l)) for l in leaves]
+    dtypes = [_canonical_dtype(l) for l in leaves]
+    plan = packed_plan(shapes, dtypes, threshold_bytes)
+    out = [None] * len(leaves)
+    new_res: List = [None] * len(leaves)
+    for _dt, idxs in plan:
+        vals = [jnp.asarray(leaves[i]) for i in idxs]
+        rvals = None if residuals is None \
+            else [jnp.asarray(residuals[i]) for i in idxs]
+        outs, nrs = reduce_bucket(vals, rvals)
+        for j, i in enumerate(idxs):
+            out[i] = outs[j]
+            if nrs is not None:
+                new_res[i] = nrs[j]
+    return out, new_res
+
+
+def flatten_bucket(vals: Sequence):
+    """Concatenate one bucket's leaves into a flat 1-D buffer; returns
+    ``(flat, unflatten)`` where ``unflatten(reduced_flat)`` splits and
+    reshapes back to the bucket's leaf shapes. For reducers that need a
+    single flat view of the bucket (the int8 per-bucket scale)."""
+    jnp = _jnp()
+    shapes = [tuple(np.shape(v)) for v in vals]
+    if len(vals) == 1:
+        flat = jnp.ravel(vals[0])
+
+        def unflatten(r):
+            return [r.reshape(shapes[0])]
+        return flat, unflatten
+    flat = jnp.concatenate([jnp.ravel(v) for v in vals])
+
+    def unflatten(r):
+        out = []
+        off = 0
+        for s in shapes:
+            n = int(np.prod(s, dtype=np.int64)) if s else 1
+            out.append(r[off:off + n].reshape(s))
+            off += n
+        return out
+    return flat, unflatten
+
+
 def bucketed_apply(values: List, threshold_bytes: int,
                    fused_fn: Callable[[List, List[str]], List],
                    names: Optional[List[str]] = None) -> List:
     """Apply ``fused_fn(bucket_values, bucket_names) -> bucket_results`` per
     bucket and reassemble results in input order."""
-    import jax.numpy as jnp
-    metas = [(tuple(np.shape(v)), jnp.asarray(v).dtype) for v in values]
-    buckets = plan_buckets(metas, threshold_bytes)
+    shapes = tuple(tuple(np.shape(v)) for v in values)
+    dtypes = tuple(str(_canonical_dtype(v)) for v in values)
+    buckets = _plan_buckets_cached(shapes, dtypes, int(threshold_bytes))
     if names is None:
         names = [f"tensor.{i}" for i in range(len(values))]
     out: List = [None] * len(values)

@@ -218,6 +218,157 @@ def bucketed_optimizer_sweep(iters: int = 5,
     }
 
 
+def _shard_map():
+    """Version-tolerant shard_map with replication checking disabled
+    (all_gather-based lowerings — broadcast, int8 — fail the static
+    replication inference on some jax versions). Public ``jax.shard_map``
+    landed after the jax this container ships (the experimental path is
+    the same function), and ``check_rep`` was renamed ``check_vma`` in
+    newer jax — tolerate both, or the sweep's variants all die and the
+    ``injit`` MICROBENCH section silently goes empty."""
+    import jax
+    try:
+        smap = jax.shard_map
+    except AttributeError:
+        from jax.experimental.shard_map import shard_map as smap
+
+    def wrap(f, **kw):
+        try:
+            return smap(f, check_rep=False, **kw)
+        except TypeError:  # renamed in newer jax
+            return smap(f, check_vma=False, **kw)
+    return wrap
+
+
+def injit_optimizer_sweep(iters: int = 5) -> dict:
+    """The compiled-plane fast path on the ResNet-50 161-gradient
+    scenario (docs/injit.md): per-leaf vs packed vs packed+bf16 vs
+    packed+int8 ``DistributedGradientTransform.update`` under shard_map
+    over every visible device, inputs pre-staged (the reduction cost, not
+    host transfer). This is the in-jit counterpart of
+    :func:`bucketed_optimizer_sweep` — the same gradient set the eager
+    bucketed path dispatches in ~161 host roundtrips runs here as a
+    handful of fused XLA collectives, which is the ROADMAP item 2 claim
+    MICROBENCH.json exists to keep honest.
+
+    ``wire_mb`` is the analytic per-device payload entering the
+    collectives (fp32 x4 / bf16 x2 / int8 x1 bytes per element; fp16's
+    upcast-psum would put fp32 back on the wire, which is why bf16 is the
+    headline half — compression.py).
+    """
+    import jax
+    import jax.numpy as jnp
+    import optax
+    from jax.sharding import Mesh, NamedSharding
+    from jax.sharding import PartitionSpec as P
+
+    import horovod_tpu as hvd
+    from .compression import Compression
+    from .fusion import packed_plan
+    from .optimizer import _packed_threshold
+
+    shard_map = _shard_map()
+    devices = jax.devices()
+    n = len(devices)
+    mesh = Mesh(np.array(devices), ("dp",))
+
+    shapes = resnet50_grad_shapes()
+    names = [f"g{i}" for i in range(len(shapes))]
+    params = {k: jnp.zeros(s, jnp.float32) for k, s in zip(names, shapes)}
+    rng = np.random.RandomState(0)
+    grads_host = {
+        k: np.stack([rng.standard_normal(s).astype(np.float32) * (d + 1)
+                     for d in range(n)])
+        for k, s in zip(names, shapes)}
+    shard = NamedSharding(mesh, P("dp"))
+    grads = {k: jax.device_put(v, shard) for k, v in grads_host.items()}
+    total_bytes = sum(int(np.prod(s, dtype=np.int64)) * 4 for s in shapes)
+    threshold = _packed_threshold()
+    plan = packed_plan([(1,) + tuple(s) for s in shapes],
+                       ["float32"] * len(shapes), threshold)
+
+    def make_variant(packing, compression):
+        opt = hvd.DistributedOptimizer(
+            optax.identity(), axis_name="dp", packing=packing,
+            compression=compression)
+        state = opt.init(params)
+        stateful = getattr(compression, "stateful", False)
+        if stateful:
+            def step(g, st):
+                return opt.update(g, st, params)
+            f = jax.jit(shard_map(
+                step, mesh=mesh, in_specs=(P("dp"), P()),
+                out_specs=(P("dp"), P())))
+            box = {"state": state}
+
+            def run():
+                u, box["state"] = f(grads, box["state"])
+                jax.block_until_ready(u)
+                return u
+        else:
+            def step(g):
+                u, _ = opt.update(g, state, params)
+                return u
+            f = jax.jit(shard_map(step, mesh=mesh, in_specs=P("dp"),
+                                  out_specs=P("dp")))
+
+            def run():
+                u = f(grads)
+                jax.block_until_ready(u)
+                return u
+        return run
+
+    elem_bytes = {"per_leaf": 4, "packed": 4, "packed_bf16": 2,
+                  "packed_int8": 1}
+    variants = {
+        "per_leaf": make_variant("per_leaf", Compression.none),
+        "packed": make_variant("packed", Compression.none),
+        "packed_bf16": make_variant("packed", Compression.bf16),
+        "packed_int8": make_variant("packed", Compression.int8),
+    }
+
+    # warmup/compile + numerics reference off the first calls
+    firsts = {k: run() for k, run in variants.items()}
+    ref = firsts["per_leaf"]
+
+    def max_err(u):
+        return max(float(jnp.max(jnp.abs(u[k].astype(jnp.float32)
+                                         - ref[k].astype(jnp.float32))))
+                   for k in names)
+
+    errs = {k: max_err(firsts[k]) for k in variants if k != "per_leaf"}
+    # interleaved round-robin, best-round estimates (see eager_sweep)
+    best = {k: float("inf") for k in variants}
+    for _ in range(max(iters, 3)):
+        for k, run in variants.items():
+            t0 = time.perf_counter()
+            run()
+            best[k] = min(best[k], time.perf_counter() - t0)
+
+    out = {
+        "scenario": "resnet50_injit_reduce",
+        "num_grads": len(shapes),
+        "total_mb": round(total_bytes / (1 << 20), 1),
+        "num_devices": n,
+        "threshold_mb": threshold // (1 << 20),
+        "num_buckets": len(plan),
+        "variants": {},
+    }
+    for k in variants:
+        row = {
+            "time_s": best[k],
+            "wire_mb": round(total_bytes * elem_bytes[k] / 4 / (1 << 20), 1),
+            "collectives_per_step": len(shapes) if k == "per_leaf"
+            else len(plan),
+        }
+        if k != "per_leaf":
+            row["max_abs_err_vs_fp32"] = errs[k]
+        out["variants"][k] = row
+    pl, pk = best["per_leaf"], best["packed"]
+    out["packed_speedup_vs_per_leaf"] = round(pl / pk, 2) if pk > 0 else None
+    return out
+
+
 def scaling_sweep_point(batch_per_device: int = 8, image_size: int = 32,
                         model_name: str = "resnet18",
                         num_iters: int = 3,

@@ -1,7 +1,7 @@
 #!/usr/bin/env python
 """Collective-plane microbenchmark driver (VERDICT r3 item 2).
 
-Runs three sections, each in killable CPU subprocesses, and writes
+Runs four sections, each in killable CPU subprocesses, and writes
 ``MICROBENCH.json``:
 
 1. ``eager_1proc``  — payload sweep of the eager plane with one process:
@@ -16,9 +16,15 @@ Runs three sections, each in killable CPU subprocesses, and writes
    throughput and efficiency = T(n)/(n*T(1)). Virtual CPU devices share
    host cores, so this validates the measurement machinery rather than
    claiming performance — the real-pod run reuses exactly this path.
+4. ``injit``        — the compiled-plane fast path (docs/injit.md) on the
+   ResNet-50 161-gradient scenario under 1/2/8 virtual devices: per-leaf
+   vs packed vs packed+bf16 vs packed+int8 DistributedOptimizer
+   reduction, with analytic wire bytes per variant. Each row carries the
+   same-scale eager bucketed time (section 1/2) so the eager-vs-compiled
+   gap for the REAL optimizer payload is a single recorded number.
 
 Usage: ``python microbench.py [--quick]``. Workers are internal
-(``--worker-eager`` / ``--worker-scaling``).
+(``--worker-eager`` / ``--worker-scaling`` / ``--worker-injit``).
 """
 
 import json
@@ -152,6 +158,35 @@ def worker_scaling(n: int, quick: bool) -> int:
     return 0
 
 
+def worker_injit(n: int, quick: bool) -> int:
+    from horovod_tpu.microbench import injit_optimizer_sweep
+    row = injit_optimizer_sweep(iters=2 if quick else 4)
+    assert row["num_devices"] == n, (row, n)
+    print(MB_TAG + json.dumps(row))
+    return 0
+
+
+def _run_injit(n: int, quick: bool, timeout: int):
+    env = _cpu_env({
+        "XLA_FLAGS": f"--xla_force_host_platform_device_count={n}",
+    })
+    cmd = [sys.executable, os.path.abspath(__file__), f"--worker-injit={n}"]
+    if quick:
+        cmd.append("--quick")
+    try:
+        p = subprocess.run(cmd, env=env, text=True, capture_output=True,
+                           timeout=timeout)
+    except subprocess.TimeoutExpired:
+        _log(f"injit n={n}: timeout")
+        return None
+    sys.stderr.write(p.stderr or "")
+    if p.returncode != 0:
+        _log(f"injit n={n}: rc={p.returncode}")
+        return None
+    rows = _collect(p.stdout or "")
+    return rows[0] if rows else None
+
+
 # ----------------------------------------------------------------- parent
 
 def main():
@@ -161,6 +196,8 @@ def main():
             return worker_eager(quick)
         if a.startswith("--worker-scaling="):
             return worker_scaling(int(a.split("=", 1)[1]), quick)
+        if a.startswith("--worker-injit="):
+            return worker_injit(int(a.split("=", 1)[1]), quick)
 
     t0 = time.time()
     result = {"quick": quick}
@@ -180,7 +217,7 @@ def main():
     result["eager_2proc"], result["bucketed_2proc"] = split_bucketed(
         _run_eager(2, quick, timeout=900))
 
-    _log("section 3/3: compiled-plane scaling sweep")
+    _log("section 3/4: compiled-plane scaling sweep")
     points = []
     for n in (1, 2, 4, 8):
         row = _run_scaling(n, quick, timeout=600)
@@ -194,6 +231,28 @@ def main():
                 p["images_per_sec_total"]
                 / (p["num_devices"] * base["images_per_sec_total"]), 3)
     result["scaling"] = points
+
+    _log("section 4/4: in-jit fast path (ResNet-50 gradient scenario)")
+    injit_rows = []
+    for n in ((1, 2) if quick else (1, 2, 8)):
+        row = _run_injit(n, quick, timeout=900)
+        if row:
+            # stitch in the same-scale eager bucketed time: n virtual
+            # devices in one program vs n processes through the eager
+            # dispatcher carry the same collective payload, so the ratio
+            # IS the compiled-vs-eager plane gap for the real optimizer
+            # scenario (ROADMAP item 2's acceptance number)
+            bk = result.get(f"bucketed_{n}proc")
+            if bk and bk.get("bucketed_s"):
+                row["eager_bucketed_same_scale_s"] = bk["bucketed_s"]
+                pk = row["variants"]["packed"]["time_s"]
+                row["packed_speedup_vs_eager_bucketed"] = round(
+                    bk["bucketed_s"] / pk, 2) if pk > 0 else None
+            injit_rows.append(row)
+            _log(f"  n={n}: packed "
+                 f"{row['variants']['packed']['time_s'] * 1e3:.1f} ms "
+                 f"(x{row['packed_speedup_vs_per_leaf']} vs per-leaf)")
+    result["injit"] = injit_rows
     result["wall_s"] = round(time.time() - t0, 1)
 
     out_path = os.path.join(ROOT, "MICROBENCH.json")
@@ -205,6 +264,8 @@ def main():
     two = result.get("eager_2proc") or []
     big = two[-1] if two else None
     bk2 = result.get("bucketed_2proc") or result.get("bucketed_1proc")
+    inj2 = next((r for r in injit_rows if r["num_devices"] == 2),
+                injit_rows[0] if injit_rows else None)
     print(json.dumps({
         "metric": "collective_microbench",
         "eager_2proc_peak_bytes_per_s": round(big["eager_bytes_per_s"])
@@ -215,6 +276,10 @@ def main():
             min(r["dispatch_latency_s"] for r in two) * 1e6) if two else None,
         "bucketed_speedup": bk2.get("bucketed_speedup") if bk2 else None,
         "scaling_points": len(result["scaling"]),
+        "injit_packed_ms": round(
+            inj2["variants"]["packed"]["time_s"] * 1e3, 1) if inj2 else None,
+        "injit_packed_vs_eager_bucketed": inj2.get(
+            "packed_speedup_vs_eager_bucketed") if inj2 else None,
     }))
     return 0
 

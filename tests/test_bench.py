@@ -75,3 +75,65 @@ def test_result_json_carries_mfu(bench):
     assert out["backend"] == "tpu"
     assert out["vs_baseline"] == pytest.approx(
         2000.0 / (1656.82 / 16), rel=1e-3)
+
+
+def _fake_run_cpu(*a, **kw):
+    return types.SimpleNamespace(
+        stdout="PROBE_OK|cpu|cpu|1\n", stderr="", returncode=0)
+
+
+def test_probe_rejects_cpu_when_tpu_requested(bench, monkeypatch):
+    """BENCH_r03-r05 regression blindness: a probe that comes up on CPU
+    while a TPU was requested is a FAILED attempt, not a result."""
+    monkeypatch.setenv("HVD_TPU_BENCH_REQUIRE_TPU", "1")
+    monkeypatch.setattr(bench, "DEADLINE", time.time() + 95)
+    monkeypatch.setattr(bench.subprocess, "run", _fake_run_cpu)
+    info, err = bench.probe_backend()
+    assert info is None
+    assert "came up as cpu" in err
+
+
+def test_probe_accepts_cpu_when_tpu_not_requested(bench, monkeypatch):
+    monkeypatch.delenv("HVD_TPU_BENCH_REQUIRE_TPU", raising=False)
+    monkeypatch.delenv("JAX_PLATFORMS", raising=False)
+    monkeypatch.delenv("PALLAS_AXON_POOL_IPS", raising=False)
+    monkeypatch.setattr(bench, "DEADLINE", time.time() + 95)
+    monkeypatch.setattr(bench.subprocess, "run", _fake_run_cpu)
+    info, err = bench.probe_backend()
+    assert info == {"platform": "cpu", "device_kind": "cpu",
+                    "num_devices": 1}
+
+
+def test_tpu_requested_detection(bench, monkeypatch):
+    monkeypatch.delenv("HVD_TPU_BENCH_REQUIRE_TPU", raising=False)
+    monkeypatch.delenv("PALLAS_AXON_POOL_IPS", raising=False)
+    monkeypatch.setenv("JAX_PLATFORMS", "cpu")
+    assert not bench._tpu_requested()
+    monkeypatch.setenv("JAX_PLATFORMS", "tpu,cpu")
+    assert bench._tpu_requested()
+    monkeypatch.setenv("JAX_PLATFORMS", "cpu")
+    monkeypatch.setenv("PALLAS_AXON_POOL_IPS", "10.0.0.1")
+    assert bench._tpu_requested()
+    monkeypatch.setenv("HVD_TPU_BENCH_REQUIRE_TPU", "0")
+    assert not bench._tpu_requested()  # explicit override wins
+
+
+def test_result_json_stamps_platform_and_fallback(bench):
+    r = types.SimpleNamespace(
+        images_per_sec_per_chip=12.0, images_per_sec_total=12.0,
+        num_chips=1, batch_per_chip=4, device_kind="cpu",
+        mfu=None, flops_per_step=None)
+    out = bench._result_json(r, "cpu_fallback")
+    assert out["cpu_fallback"] is True
+    assert out["platform"] == "cpu"
+    live = bench._result_json(r, "tpu", platform="tpu")
+    assert live["cpu_fallback"] is False
+    assert live["platform"] == "tpu"
+
+
+def test_fell_back_classifier(bench):
+    assert bench._fell_back(None)
+    assert bench._fell_back({"backend": "cpu_fallback",
+                             "cpu_fallback": True})
+    assert bench._fell_back({"backend": "none"})
+    assert not bench._fell_back({"backend": "tpu", "cpu_fallback": False})
