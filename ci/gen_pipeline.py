@@ -23,9 +23,11 @@ COMPOSE_PATH = os.path.join(HERE, "docker-compose.test.yml")
 #: suites every service runs (path, parallelism-safe, timeout minutes)
 COMMON_SUITES = [
     ("lint-knobs", "python tools/check_knobs.py", 5),
-    # the full concurrency-aware static-analysis suite (lock-discipline,
-    # lock-order, fault-site/metric contracts, jit-purity, knobs): zero
-    # unwaived findings and the waiver budget enforced on every service
+    # the full static-analysis suite — concurrency (lock-discipline,
+    # lock-order), contracts (fault-sites, metrics, knobs), jit-purity,
+    # and the distributed-semantics passes (collective-divergence,
+    # collective-contract, mesh-axis): zero unwaived findings, no new
+    # waivers, and the waiver budget enforced on every service
     # (docs/static_analysis.md)
     ("lint-static", "python -m tools.analyze", 10),
     # chaos tests are excluded here because the chaos suite below is

@@ -335,6 +335,10 @@ def shutdown() -> None:
             w.timeline.close()
         if w.stall_inspector is not None:
             w.stall_inspector.stop()
+        # flush + drop the collective schedule ledger so an elastic
+        # reset's next generation restarts at sequence 0 on every rank
+        from . import _schedule
+        _schedule.reset()
         _metrics.stop_http_server(w.metrics_server)
         w.metrics_server = None
         _M_SHUTDOWNS.inc()

@@ -39,6 +39,7 @@ from typing import List, Optional, Sequence
 
 import numpy as np
 
+from . import _schedule as _sched
 from . import basics as _basics
 from . import config as _config
 from . import faults as _faults
@@ -768,7 +769,7 @@ def allreduce_async(tensor, average=None, name: Optional[str] = None,
 
     _record_round(w, ("allreduce", name, tuple(local.shape),
                       _dtype_str(local.dtype), op.value, prescale_factor,
-                      postscale_factor))
+                      postscale_factor), pset=process_set)
     # Snapshot join state at submit time: a collective submitted before
     # join() must carry real data even if the dispatcher runs it after.
     joined_at_submit = w.joined
@@ -855,7 +856,8 @@ def grouped_allreduce_async(tensors: Sequence, average=None,
     shapes = tuple(tuple(l.shape) for l in locals_)
     dtypes = tuple(_dtype_str(l.dtype) for l in locals_)
     _record_round(w, ("grouped_allreduce", base, shapes, dtypes,
-                      op.value, prescale_factor, postscale_factor))
+                      op.value, prescale_factor, postscale_factor),
+                  pset=process_set)
     joined_at_submit = w.joined
 
     def dispatch():
@@ -913,7 +915,7 @@ def allgather_async(tensor, name: Optional[str] = None, process_set=None) -> int
     wm = process_set or w.world_mesh
     local = _stage_input(tensor)
     _record_round(w, ("allgather", name, tuple(local.shape),
-                      _dtype_str(local.dtype)))
+                      _dtype_str(local.dtype)), pset=process_set)
 
     def dispatch():
         jax, jnp = _jax(), _jnp()
@@ -1013,7 +1015,7 @@ def broadcast_async(tensor, root_rank: int, name: Optional[str] = None,
         raise ValueError(f"root_rank {root_rank} out of range for world "
                          f"size {nproc}")
     _record_round(w, ("broadcast", name, tuple(local.shape),
-                      _dtype_str(local.dtype), root_rank))
+                      _dtype_str(local.dtype), root_rank), pset=process_set)
 
     def dispatch():
         jax, jnp = _jax(), _jnp()
@@ -1073,7 +1075,8 @@ def grouped_broadcast_async(tensors: Sequence, root_rank: int,
                          f"size {nproc}")
     shapes = tuple(tuple(l.shape) for l in locals_)
     dtypes = tuple(_dtype_str(l.dtype) for l in locals_)
-    _record_round(w, ("grouped_broadcast", base, shapes, dtypes, root_rank))
+    _record_round(w, ("grouped_broadcast", base, shapes, dtypes, root_rank),
+                  pset=process_set)
 
     def dispatch():
         jax, jnp = _jax(), _jnp()
@@ -1164,7 +1167,8 @@ def alltoall_async(tensor, splits=None, name: Optional[str] = None,
         and len(set(splits)) == 1
     local = staged if device_path else np.asarray(staged)
     _record_round(w, ("alltoall", name, tuple(local.shape),
-                      _dtype_str(local.dtype), tuple(splits)))
+                      _dtype_str(local.dtype), tuple(splits)),
+                  pset=process_set)
 
     def dispatch():
         jax, jnp = _jax(), _jnp()
@@ -1369,7 +1373,11 @@ def synchronize(handle: int):
 _JOIN_ROUND_NAME = "hvd.join.round"
 
 
-def _record_round(w, entry) -> None:
+def _record_round(w, entry, pset=None) -> None:
+    # schedule ledger first (HVD_TPU_SCHEDULE_CHECK, _schedule.py): the
+    # join markers are part of the cross-rank schedule even though the
+    # replay log below excludes them. A no-op when the ledger is off.
+    _sched.record(entry, pset)
     if entry[1].startswith(("hvd.join.", "horovod_tpu.join.")):
         return
     log = getattr(w, "_join_round_log", None)

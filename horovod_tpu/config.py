@@ -344,6 +344,17 @@ LOCK_CHECK = _register(
          "deadlock) or a self-deadlocking re-acquisition. Off by default "
          "(plain locks, zero overhead); the test suites run with it on. "
          "See docs/static_analysis.md.")
+SCHEDULE_CHECK = _register(
+    "SCHEDULE_CHECK", False, _parse_bool,
+    help="Enable the runtime collective schedule ledger: every eager "
+         "collective submission is fingerprinted (verb, name, dtype, "
+         "rank-invariant shape, process_set) into a per-rank rolling "
+         "hash published through the rendezvous KV store; on a stall "
+         "deadline the per-rank ledgers are diffed and the first "
+         "mismatched call site is named (e.g. \"rank 1 submitted "
+         "allreduce('dense_2') where rank 0 submitted "
+         "allreduce('dense_1')\") instead of a silent hang. Off by "
+         "default (zero overhead); see docs/static_analysis.md.")
 RETRY_MAX_ATTEMPTS = _register(
     "RETRY_MAX_ATTEMPTS", 5, int,
     help="Total attempts (first call + retries) for transient host-plane "

@@ -69,6 +69,24 @@ TRANSFORMER_RULES: Tuple[Tuple[str, Optional[str]], ...] = (
 )
 
 
+def require_axes(mesh, *axis_names: str):
+    """Fail fast when an axis name is not on ``mesh``.
+
+    The runtime counterpart of the ``mesh-axis`` lint
+    (docs/static_analysis.md): the lint proves *literal* axis names
+    resolve, this check covers names that arrive in variables. Without
+    it a typo'd axis surfaces as an opaque trace-time NameError deep
+    inside shard_map — or, worse, a mispaired collective.
+    """
+    declared = tuple(mesh.axis_names)
+    missing = [a for a in axis_names if a and a not in declared]
+    if missing:
+        raise ValueError(
+            f"axis name(s) {missing} not on this mesh (declared axes, "
+            f"outermost first: {declared}); pipeline/MoE stages must "
+            f"agree on the mesh's axis inventory and order")
+
+
 def batch_spec():
     """PartitionSpec for a (batch, ...) input: batch shards over dp and fsdp
     (fsdp acts as extra data parallelism for the forward pass)."""

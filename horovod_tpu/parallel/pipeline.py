@@ -67,6 +67,9 @@ def pipeline_apply(stage_fn: Callable, stacked_params, microbatches, mesh,
     """
     from jax.sharding import PartitionSpec as Spec
 
+    from .mesh_utils import require_axes
+    require_axes(mesh, axis_name)
+
     def body(params, mb):
         # shard_map leaves a leading axis of size 1 on the stacked params
         params = jax.tree_util.tree_map(lambda a: a[0], params)

@@ -178,8 +178,12 @@ class KVStoreServer:
         #: scopes excluded from the journal: high-frequency liveness data
         #: (heartbeats) whose value is precisely that it does NOT survive
         #: a restart — journaling it would fsync per beat and resurrect
-        #: stale liveness after recovery
-        self.ephemeral_scopes: set = set()
+        #: stale liveness after recovery. The collective schedule ledger
+        #: (scope 'schedule', _schedule.py) is ephemeral for the same
+        #: reason: per-generation sequence state published at up to
+        #: 5 Hz/rank, and replaying a dead generation's ledgers after a
+        #: coordinator restart would fabricate divergence diagnostics.
+        self.ephemeral_scopes: set = {"schedule"}
 
         cfg = _config.Config()
         if journal_dir is None:

@@ -25,6 +25,7 @@ import time
 from typing import Dict
 
 from . import _locks
+from . import _schedule
 from . import config as _config
 from . import faults as _faults
 from . import metrics as _metrics
@@ -59,6 +60,15 @@ class StallInspector:
         self._h = self._nat.cdll.hvd_stall_create() if self._nat else None
         self._stop_evt = threading.Event()
         self._shutdown_deadline_hit = False
+        #: last schedule-ledger diagnosis (HVD_TPU_SCHEDULE_CHECK): set
+        #: by the poll thread on a stall, appended to warnings and to
+        #: the StallError raised at waiters — the one-line "which rank
+        #: submitted what" answer to an otherwise silent hang. Cleared
+        #: when the stall episode resolves and refreshed when older
+        #: than the warn deadline, so a hint computed from a transient
+        #: stall can never contaminate a later, unrelated one.
+        self._divergence_hint = ""
+        self._hint_time = 0.0
         self._stopped = False
         self._thread = None
         if not self._cfg.get(_config.STALL_CHECK_DISABLE):
@@ -101,9 +111,20 @@ class StallInspector:
     def check_shutdown(self):
         """Called from synchronize(); raises if the shutdown deadline was hit."""
         if self._shutdown_deadline_hit:
+            if not self._divergence_hint:
+                # cache the diagnosis so N waiter threads hitting the
+                # deadline pay one cross-rank KV sweep (and one metric
+                # increment), not one each
+                self._divergence_hint = _schedule.divergence_hint(
+                    self._world)
+                self._hint_time = time.monotonic()
+                if self._divergence_hint:
+                    _schedule.note_divergence()
+            hint = self._divergence_hint
             raise StallError(
                 "horovod_tpu: collective stalled beyond "
-                "HVD_TPU_STALL_SHUTDOWN_TIME_SECONDS; shutting down.")
+                "HVD_TPU_STALL_SHUTDOWN_TIME_SECONDS; shutting down."
+                + (f" {hint}" if hint else ""))
 
     # -- background loop -----------------------------------------------------
     def _loop(self):
@@ -113,13 +134,52 @@ class StallInspector:
         shutdown_after = self._cfg.get(_config.STALL_SHUTDOWN_TIME_SECONDS)
         poll = min(max(warn_after / 4.0, 0.25), 10.0)
         while not self._stop_evt.wait(poll):
-            for name in self._scan(warn_after, shutdown_after):
+            # keep this rank's schedule ledger visible to peers even
+            # while its submitter threads are blocked in a collective
+            # (rate-limited publishes skip the tail); a no-op when the
+            # ledger is off or nothing new was recorded
+            _schedule.flush_local()
+            stalled = self._scan(warn_after, shutdown_after)
+            now = time.monotonic()
+            if stalled:
+                # one ledger diff per stall episode, refreshed when the
+                # cached one predates this episode's warn window: names
+                # the first mismatched call site across ranks (or ''
+                # when the ledger is off / schedules agree / KV
+                # unreachable)
+                if not self._divergence_hint or \
+                        now - self._hint_time > warn_after:
+                    prior = self._divergence_hint
+                    self._divergence_hint = _schedule.divergence_hint(
+                        self._world)
+                    self._hint_time = now
+                    if self._divergence_hint and not prior:
+                        _schedule.note_divergence()
+            elif self._divergence_hint and \
+                    not self._shutdown_deadline_hit and self._quiet():
+                # episode resolved (nothing stalled, nothing still
+                # pending past the warn deadline): a stale diagnosis
+                # must not contaminate a later, unrelated stall
+                self._divergence_hint = ""
+            for name in stalled:
                 _M_STALL_WARNINGS.inc()
                 log.warning(
                     "One or more collectives stalled for over %.0fs: %s. "
                     "This may indicate that a peer process is down or a "
                     "different subset of collectives was submitted on "
-                    "another process.", warn_after, name)
+                    "another process.%s", warn_after, name,
+                    " " + self._divergence_hint
+                    if self._divergence_hint else "")
+
+    def _quiet(self) -> bool:
+        """No collective is still flagged stalled (python-table path);
+        the native table exposes only newly-stalled names, so quiet is
+        assumed there — check_shutdown recomputes a fresh diagnosis
+        whenever the cache is empty."""
+        if self._h is not None:
+            return True
+        with self._lock:
+            return not self._warned
 
     def _scan(self, warn_after, shutdown_after):
         """One inspection pass; returns newly-stalled names and updates the
@@ -195,3 +255,4 @@ class StallInspector:
             self._pending.clear()
             self._warned.clear()
         self._shutdown_deadline_hit = False
+        self._divergence_hint = ""

@@ -19,6 +19,12 @@ os.environ["JAX_PLATFORMS"] = "cpu"
 # regression fails a test instead of wedging a job. setdefault, so
 # HVD_TPU_LOCK_CHECK=0 can still turn it off for an overhead comparison.
 os.environ.setdefault("HVD_TPU_LOCK_CHECK", "1")
+# Likewise the collective schedule ledger (docs/static_analysis.md): every
+# eager collective submission is fingerprinted into the per-rank ledger, so
+# any test that wedges on a cross-rank divergence names the first mismatched
+# call site instead of timing out silently. Publishing only happens when a
+# rendezvous KV store is configured; otherwise the ledger stays local.
+os.environ.setdefault("HVD_TPU_SCHEDULE_CHECK", "1")
 
 import jax  # noqa: E402
 

@@ -1,0 +1,54 @@
+"""Seeded collective-divergence / collective-contract bugs.
+
+Every finding in this file is asserted exactly by
+tests/test_static_analysis.py — line numbers matter.
+"""
+
+import numpy as np
+
+import horovod_tpu as hvd
+
+
+def diverging_branch(x):
+    # rank-dependent guard whose arms submit DIFFERENT collectives
+    if hvd.rank() == 0:
+        return hvd.allreduce(x, name="dense_1")
+    return hvd.allgather(x, name="embed")
+
+
+def early_return_skips(x):
+    r = hvd.rank()
+    if r != 0:
+        return None
+    return hvd.allreduce(x, name="grads")
+
+
+def rank_dependent_loop(x):
+    out = x
+    for _ in range(hvd.rank()):
+        out = hvd.allreduce(out, name="loop_reduce")
+    return out
+
+
+def conflicting_average_op(x):
+    return hvd.allreduce(x, average=True, op=hvd.Sum, name="scaled")
+
+
+def auto_named_in_rank_loop(x):
+    while hvd.rank() < int(x[0]):
+        x = hvd.allreduce(x)
+    return x
+
+
+def name_bound_to_two_verbs(x, gather):
+    if gather:
+        return hvd.allgather(x, name="shared_key")
+    return hvd.allreduce(x, name="shared_key")
+
+
+def nested_rank_guard(x):
+    # nested rank-dependent branches must report ONCE, innermost
+    if hvd.rank() == 0:
+        if hvd.rank() != 1:
+            return hvd.allreduce(x, name="nested")
+    return x

@@ -1,0 +1,31 @@
+"""Seeded mesh-axis bugs: an undeclared (typo'd) axis at a collective
+primitive and a PartitionSpec transposing the declared axis order.
+Line numbers are asserted by tests/test_static_analysis.py.
+"""
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec as P
+
+AXIS_ORDER = ("dp", "fsdp", "tp")
+
+
+def make_mesh(devices):
+    return Mesh(np.array(devices), AXIS_ORDER)
+
+
+def typo_axis(x):
+    return jax.lax.psum(x, "ddp")
+
+
+def transposed_spec():
+    return P(("tp", "dp"))
+
+
+def typo_axis_index():
+    return jax.lax.axis_index("dqp")
+
+
+def typo_shard_axes(f, mesh):
+    # a typo'd axis_names= must be flagged, not self-whitelisted
+    return jax.shard_map(f, mesh=mesh, axis_names=("dqq",))

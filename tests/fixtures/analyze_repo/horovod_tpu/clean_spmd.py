@@ -1,0 +1,64 @@
+"""Correct SPMD idioms: the false-positive fence for the
+distributed-semantics checkers (collective-divergence,
+collective-contract, mesh-axis). Every function here must produce
+ZERO findings.
+"""
+
+import jax
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+import horovod_tpu as hvd
+
+MESH_AXES = ("dp", "tp")
+
+
+def symmetric_contribution(x):
+    """Rank-dependent DATA under a rank-invariant collective sequence —
+    the canonical correct shape (zero contributions from some ranks)."""
+    if hvd.rank() == 0:
+        local = np.asarray(x)
+    else:
+        local = np.zeros_like(x)
+    return hvd.allreduce(local, name="sym")
+
+
+def same_sequence_both_arms(x):
+    if hvd.rank() % 2 == 0:
+        out = hvd.allreduce(np.asarray(x), name="both_arms")
+    else:
+        out = hvd.allreduce(np.zeros_like(x), name="both_arms")
+    return out
+
+
+def rank_guard_host_only(path, blob):
+    """Rank guards around pure host work (logging, checkpoint writes)
+    are idiomatic and must stay silent — no collective is skipped."""
+    if hvd.rank() != 0:
+        return None
+    with open(path, "w") as f:
+        f.write(blob)
+    return path
+
+
+def world_size_guard(x):
+    # world-size conditions are identical on every rank: not divergence
+    if hvd.size() > 1:
+        return hvd.allreduce(x, name="size_guarded")
+    return x
+
+
+def data_driven_loop(xs):
+    # loop count from data every rank shares: names may auto-generate
+    out = []
+    for x in xs:
+        out.append(hvd.allreduce(x, name=None))
+    return out
+
+
+def declared_axis_use(x):
+    return jax.lax.psum(x, "dp")
+
+
+def ordered_spec():
+    return P(("dp", "tp"))

@@ -1,0 +1,85 @@
+"""Worker for the seeded collective-schedule-divergence drill.
+
+One rank (selected by the fault spec, e.g.
+``drill.schedule.skip:error:rank=1``) skips the 'dense_1' collective —
+the classic rank-dependent-branch bug the static checker catches in
+package code but cannot see in user code. With the consistency exchange
+off (``HVD_TPU_CHECK_CONSISTENCY=0``, simulating the reference's
+silent-deadlock mode) the surviving rank wedges; the schedule ledger
+(``HVD_TPU_SCHEDULE_CHECK=1``) + stall inspector must convert that wedge
+into a StallError naming the first mismatched call site within the
+stall deadline — not a harness timeout.
+"""
+
+import os
+import sys
+import time
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ.pop("XLA_FLAGS", None)
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+import horovod_tpu as hvd  # noqa: E402
+from horovod_tpu import _schedule  # noqa: E402
+from horovod_tpu import faults  # noqa: E402
+from horovod_tpu.exceptions import StallError  # noqa: E402
+
+_SKIP = faults.FaultPoint("drill.schedule.skip")
+
+
+def main() -> int:
+    hvd.init()
+    rank = hvd.rank()
+
+    hvd.allreduce(np.ones(3, np.float32), name="warm")
+
+    skipped = False
+    try:
+        _SKIP.fire()
+        hvd.allreduce(np.ones(3, np.float32), name="dense_1")
+    except faults.InjectedFault:
+        skipped = True  # the seeded divergence: this rank skips dense_1
+
+    led = _schedule.ledger()
+    try:
+        hvd.allreduce(np.ones(3, np.float32), name="dense_2")
+    except StallError as e:
+        msg = str(e)
+        print(f"rank {rank}: STALL {msg}", flush=True)
+        named = "schedule divergence" in msg and (
+            "dense_1" in msg or "dense_2" in msg or "collective(s)" in msg)
+        # tell the peer the diagnosis landed so it can exit cleanly,
+        # give it a beat to see the key, then leave hard (the peer set
+        # is wedged — a distributed shutdown barrier would hang)
+        try:
+            led._kv_client().put("schedule", "diagnosed", msg.encode())
+        except Exception:
+            pass
+        time.sleep(2)
+        os._exit(0 if named else 3)
+
+    if led is not None:
+        led.flush()
+    print(f"rank {rank}: DONE skipped={skipped}", flush=True)
+    # stay alive (gloo connections up) until the wedged peer has fetched
+    # the ledgers and named the divergence, then exit without the
+    # distributed shutdown barrier (the peer cannot reach it)
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
+        try:
+            if led is not None and led._kv_client() is not None and \
+                    led._kv_client().get("schedule", "diagnosed"):
+                break
+        except Exception:
+            pass
+        time.sleep(0.2)
+    os._exit(0)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -153,8 +153,10 @@ def test_serving_suite_is_seeded_and_exclusive():
 
 def test_lint_static_suite_in_every_service():
     """The unified static-analysis suite (tools/analyze: lock-discipline,
-    lock-order, contract lints, jit-purity, knobs) runs as its own CI
-    suite on every service, and the module it invokes exists."""
+    lock-order, contract lints, jit-purity, knobs, plus the
+    distributed-semantics passes collective-divergence /
+    collective-contract / mesh-axis) runs as its own CI suite on every
+    service, and the module it invokes registers all nine checkers."""
     names = [name for name, _cmd, _t in COMMON_SUITES]
     assert "lint-static" in names
     by_name = {name: cmd for name, cmd, _t in COMMON_SUITES}
@@ -162,6 +164,14 @@ def test_lint_static_suite_in_every_service():
     root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     assert os.path.exists(os.path.join(root, "tools", "analyze",
                                        "__main__.py"))
+    import sys
+    if root not in sys.path:
+        sys.path.insert(0, root)
+    from tools.analyze import ALL_CHECKERS, CHECKERS  # noqa: F401
+    assert len(CHECKERS) == 9, sorted(CHECKERS)
+    for name in ("collective-divergence", "collective-contract",
+                 "mesh-axis"):
+        assert name in CHECKERS
     # the "tree is lint-clean" contract itself is asserted once, in
     # tests/test_static_analysis.py (in-process + CLI) — not repeated
     # here: tier-1 is wallclock-budgeted and each full-repo analysis

@@ -1,0 +1,368 @@
+"""Collective schedule ledger suite (ISSUE 8).
+
+Three layers, mirroring the lock-sentinel suite:
+
+1. **the ledger itself** — fingerprints are rank-invariant (ragged
+   allgather dims and alltoallv splits excluded), the rolling hash
+   moves per submission, and ``diff_ledgers`` names the first
+   mismatched call site in one line;
+2. **KV publication** — a ledger publishes through the rendezvous KV
+   store and a peer's ledger is fetched and diffed from it;
+3. **the drill** — a seeded ``HVD_TPU_FAULT_SPEC`` divergence (one rank
+   skips a collective) is converted from a silent wedge into a
+   StallError naming the call site within the stall deadline (the
+   multiprocess variant is marked ``slow`` per the tier-1 wallclock
+   budget), and the sentinel is zero-overhead when off.
+"""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from horovod_tpu import _schedule
+
+WORKER = os.path.join(os.path.dirname(__file__),
+                      "schedule_divergence_worker.py")
+
+
+@pytest.fixture(autouse=True)
+def _fresh_ledger():
+    _schedule.reset()
+    yield
+    _schedule.reset()
+
+
+def _mk_entries(*summaries, start=1):
+    """Ledger-dict entries from (summary, digest) shorthand."""
+    return [[i, s, d] for i, (s, d) in enumerate(summaries, start)]
+
+
+class TestLedger:
+    def test_records_and_rolls_hash(self, monkeypatch):
+        monkeypatch.setenv("HVD_TPU_SCHEDULE_CHECK", "1")
+        _schedule.reset()
+        led = _schedule.ledger()
+        assert led is not None
+        led.record(("allreduce", "a", (3,), "float32", "average", 1.0, 1.0))
+        h1 = led.snapshot()["hash"]
+        led.record(("allgather", "b", (2, 2), "float32"))
+        snap = led.snapshot()
+        assert snap["n"] == 2 and snap["hash"] != h1
+        assert [e[1] for e in snap["entries"]] == \
+            ["allreduce('a')", "allgather('b')"]
+
+    def test_rank_invariant_fields_allow_ragged_gathers(self):
+        # allgather first dim and alltoall splits are per-rank DATA, not
+        # schedule: two ranks' fingerprints must agree
+        a = _schedule._rank_invariant_fields(
+            ("allgather", "g", (5, 4), "float32"))
+        b = _schedule._rank_invariant_fields(
+            ("allgather", "g", (2, 4), "float32"))
+        assert a == b
+        a = _schedule._rank_invariant_fields(
+            ("alltoall", "t", (6, 4), "float32", (4, 2)))
+        b = _schedule._rank_invariant_fields(
+            ("alltoall", "t", (6, 4), "float32", (3, 3)))
+        assert a == b
+        # but an allreduce SHAPE mismatch stays visible
+        a = _schedule._rank_invariant_fields(
+            ("allreduce", "r", (3,), "float32", "sum", 1.0, 1.0))
+        b = _schedule._rank_invariant_fields(
+            ("allreduce", "r", (4,), "float32", "sum", 1.0, 1.0))
+        assert a != b
+
+    def test_eager_collectives_feed_the_ledger(self, monkeypatch,
+                                               hvd_world):
+        monkeypatch.setenv("HVD_TPU_SCHEDULE_CHECK", "1")
+        _schedule.reset()
+        hvd = hvd_world
+        hvd.allreduce(np.ones(3, np.float32), name="dense_1")
+        hvd.allgather(np.ones((2, 2), np.float32), name="embed")
+        snap = _schedule.ledger().snapshot()
+        assert [e[1] for e in snap["entries"]] == \
+            ["allreduce('dense_1')", "allgather('embed')"]
+
+    def test_off_is_zero_overhead(self, monkeypatch):
+        monkeypatch.setenv("HVD_TPU_SCHEDULE_CHECK", "0")
+        _schedule.reset()
+        assert _schedule.ledger() is None
+        # record() with the ledger off is a no-op, not an error
+        _schedule.record(
+            ("allreduce", "x", (1,), "float32", "sum", 1.0, 1.0))
+        assert _schedule.ledger() is None
+        assert _schedule.divergence_hint() == ""
+
+
+class TestDiff:
+    def test_agreement_is_silent(self):
+        e = _mk_entries(("allreduce('a')", "d1"), ("allgather('b')", "d2"))
+        led = {"n": 2, "hash": "h", "entries": e}
+        assert _schedule.diff_ledgers({0: led, 1: dict(led)}) is None
+
+    def test_first_mismatch_is_named(self):
+        a = {"n": 3, "hash": "ha", "entries": _mk_entries(
+            ("allreduce('warm')", "w"), ("allreduce('dense_1')", "d1"),
+            ("allreduce('dense_2')", "d2"))}
+        b = {"n": 3, "hash": "hb", "entries": _mk_entries(
+            ("allreduce('warm')", "w"), ("allgather('embed')", "e"),
+            ("allreduce('dense_2')", "d2"))}
+        msg = _schedule.diff_ledgers({0: a, 3: b})
+        assert msg == ("collective schedule divergence at collective "
+                       "#2: rank 3 submitted allgather('embed') where "
+                       "rank 0 submitted allreduce('dense_1')")
+
+    def test_metadata_mismatch_same_name(self):
+        a = {"n": 1, "hash": "ha",
+             "entries": _mk_entries(("allreduce('x')", "d-f32"))}
+        b = {"n": 1, "hash": "hb",
+             "entries": _mk_entries(("allreduce('x')", "d-f64"))}
+        msg = _schedule.diff_ledgers({0: a, 1: b})
+        assert "different metadata" in msg and "rank 1" in msg
+
+    def test_stopped_rank_is_named(self):
+        a = {"n": 2, "hash": "ha", "entries": _mk_entries(
+            ("allreduce('warm')", "w"), ("allreduce('dense_1')", "d1"))}
+        b = {"n": 1, "hash": "hb",
+             "entries": _mk_entries(("allreduce('warm')", "w"))}
+        msg = _schedule.diff_ledgers({0: a, 1: b})
+        assert "rank 1 stopped after 1 collective(s)" in msg
+        assert "allreduce('dense_1')" in msg
+
+    def test_single_ledger_is_silent(self):
+        assert _schedule.diff_ledgers(
+            {0: {"n": 5, "hash": "h", "entries": []}}) is None
+
+
+class TestKVPublication:
+    @pytest.fixture
+    def kv(self):
+        from horovod_tpu.runner.rendezvous import KVStoreServer
+        s = KVStoreServer(port=0)
+        port = s.start()
+        yield s, port
+        s.stop()
+
+    def test_publish_fetch_and_hint(self, kv, monkeypatch):
+        server, port = kv
+        monkeypatch.setenv("HVD_TPU_RENDEZVOUS_ADDR", "127.0.0.1")
+        monkeypatch.setenv("HVD_TPU_RENDEZVOUS_PORT", str(port))
+        monkeypatch.setenv("HVD_TPU_SCHEDULE_CHECK", "1")
+        _schedule.reset()
+        led = _schedule.ledger()
+        led.record(("allreduce", "warm", (3,), "float32", "sum", 1.0, 1.0))
+        led.record(("allreduce", "dense_1", (3,), "float32", "sum",
+                    1.0, 1.0))
+        led.flush()
+        # a skewed peer, published directly into the store
+        snap = led.snapshot()
+        peer = {"rank": 1, "n": 2, "hash": "other", "entries": [
+            snap["entries"][0],
+            [2, "allreduce('dense_2')", "deadbeef"]]}
+        server.put("schedule", "rank1", json.dumps(peer).encode())
+        peers = led.fetch_peers(2)
+        assert set(peers) == {0, 1}
+        msg = _schedule.diff_ledgers(peers)
+        assert msg is not None and "#2" in msg
+        assert "rank 1 submitted allreduce('dense_2')" in msg
+        assert "rank 0 submitted allreduce('dense_1')" in msg
+
+    def test_reset_withdraws_published_ledger(self, kv, monkeypatch):
+        """An elastic reset must DELETE this rank's ledger from the KV
+        store: a dead generation's ledger left behind would be diffed
+        against the next generation's young ledgers and fabricate a
+        divergence diagnostic."""
+        server, port = kv
+        monkeypatch.setenv("HVD_TPU_RENDEZVOUS_ADDR", "127.0.0.1")
+        monkeypatch.setenv("HVD_TPU_RENDEZVOUS_PORT", str(port))
+        monkeypatch.setenv("HVD_TPU_SCHEDULE_CHECK", "1")
+        _schedule.reset()
+        led = _schedule.ledger()
+        led.record(("allreduce", "gen0", (3,), "float32", "sum", 1.0, 1.0))
+        led.flush()
+        assert server.get("schedule", "rank0") is not None
+        _schedule.reset()                 # generation teardown
+        assert server.get("schedule", "rank0") is None
+
+    def test_flush_local_publishes_only_dirty_tails(self, kv, monkeypatch):
+        """The stall inspector's periodic flush makes a blocked rank's
+        unpublished tail visible (rate-limited publishes skip it), but
+        stays silent when nothing new was recorded."""
+        server, port = kv
+        monkeypatch.setenv("HVD_TPU_RENDEZVOUS_ADDR", "127.0.0.1")
+        monkeypatch.setenv("HVD_TPU_RENDEZVOUS_PORT", str(port))
+        monkeypatch.setenv("HVD_TPU_SCHEDULE_CHECK", "1")
+        _schedule.reset()
+        led = _schedule.ledger()
+        led.record(("allreduce", "a", (3,), "float32", "sum", 1.0, 1.0))
+        led.flush()
+        # simulate the rate-limited window: a record whose publish was
+        # throttled (make the throttle think a publish just happened)
+        with led._lock:
+            led._last_publish = time.monotonic()
+        led.record(("allreduce", "b", (3,), "float32", "sum", 1.0, 1.0))
+        assert json.loads(server.get("schedule", "rank0"))["n"] == 1
+        _schedule.flush_local()           # the inspector's poll hook
+        assert json.loads(server.get("schedule", "rank0"))["n"] == 2
+        server.delete("schedule", "rank0")
+        _schedule.flush_local()           # nothing dirty: no republish
+        assert server.get("schedule", "rank0") is None
+
+    def test_unreachable_store_never_raises(self, monkeypatch):
+        monkeypatch.setenv("HVD_TPU_RENDEZVOUS_ADDR", "127.0.0.1")
+        monkeypatch.setenv("HVD_TPU_RENDEZVOUS_PORT", "9")  # discard port
+        monkeypatch.setenv("HVD_TPU_SCHEDULE_CHECK", "1")
+        _schedule.reset()
+        led = _schedule.ledger()
+        led.record(("allreduce", "a", (1,), "float32", "sum", 1.0, 1.0))
+        led.flush()                      # best-effort: swallowed
+        assert _schedule.divergence_hint() == ""
+
+
+class TestStallWiring:
+    def test_stall_deadline_carries_the_diagnostic(self, monkeypatch):
+        """The acceptance drill's single-process half: when the
+        shutdown deadline fires, the StallError at the waiter carries
+        the ledger's named-call-site diagnostic."""
+        import horovod_tpu.config as C
+        import horovod_tpu.stall as stall_mod
+        from horovod_tpu import faults as F
+        from horovod_tpu.exceptions import StallError
+        from horovod_tpu.stall import StallInspector
+
+        hint = ("collective schedule divergence at collective #2: rank "
+                "1 submitted allreduce('dense_2') where rank 0 "
+                "submitted allreduce('dense_1')")
+        monkeypatch.setattr(stall_mod._schedule, "divergence_hint",
+                            lambda world=None: hint)
+
+        class _W:
+            pass
+
+        w = _W()
+        w.config = C.Config({C.STALL_CHECK_TIME_SECONDS: 0.1,
+                             C.STALL_SHUTDOWN_TIME_SECONDS: 0.2})
+        F.configure("stall.deadline:error:once", seed=11)
+        insp = StallInspector(w)
+        try:
+            deadline = time.monotonic() + 10
+            while not insp._shutdown_deadline_hit:
+                assert time.monotonic() < deadline, "fault never fired"
+                time.sleep(0.02)
+            with pytest.raises(StallError, match="rank 1 submitted"):
+                insp.check_shutdown()
+        finally:
+            insp.stop()
+            F.configure("", seed=0)
+        # stop() clears the stashed hint with the rest of the state
+        assert insp._divergence_hint == ""
+
+    def test_hint_clears_when_stall_episode_resolves(self, monkeypatch):
+        """A hint computed during a transient stall must not
+        contaminate a later, unrelated one: once nothing is stalled
+        and nothing is still pending past the warn deadline, the
+        cached diagnosis is dropped."""
+        import horovod_tpu.config as C
+        import horovod_tpu.stall as stall_mod
+        from horovod_tpu.stall import StallInspector
+
+        monkeypatch.setattr(stall_mod._schedule, "divergence_hint",
+                            lambda world=None: "bogus transient hint")
+        # force the python pending table: episode resolution is decided
+        # from _warned, which the native table does not expose
+        monkeypatch.setattr(stall_mod, "_native_get", lambda: None)
+
+        class _W:
+            pass
+
+        w = _W()
+        w.config = C.Config({C.STALL_CHECK_TIME_SECONDS: 0.1,
+                             C.STALL_SHUTDOWN_TIME_SECONDS: 0.0})
+        insp = StallInspector(w)
+        assert insp._h is None
+        try:
+            insp.record_submit("transient")
+            deadline = time.monotonic() + 10
+            while not insp._divergence_hint:
+                assert time.monotonic() < deadline, "hint never computed"
+                time.sleep(0.02)
+            insp.record_done("transient")   # the stall resolves
+            deadline = time.monotonic() + 10
+            while insp._divergence_hint:
+                assert time.monotonic() < deadline, "hint never cleared"
+                time.sleep(0.02)
+        finally:
+            insp.stop()
+
+
+def _free_port():
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+@pytest.mark.integration
+@pytest.mark.slow
+def test_multiprocess_divergence_drill_names_call_site():
+    """Seeded HVD_TPU_FAULT_SPEC divergence across 2 real processes:
+    rank 1 skips 'dense_1', rank 0 wedges on 'dense_2', and the stall
+    deadline surfaces a StallError NAMING the mismatched call site —
+    within the deadline, not the harness timeout."""
+    from horovod_tpu.runner.rendezvous import KVStoreServer
+    server = KVStoreServer(port=0)
+    kv_port = server.start()
+    coord_port = _free_port()
+    procs = []
+    try:
+        for pid in range(2):
+            env = dict(os.environ)
+            env.pop("XLA_FLAGS", None)
+            repo_root = os.path.dirname(os.path.dirname(
+                os.path.abspath(WORKER)))
+            env.update({
+                "PYTHONPATH": repo_root + os.pathsep +
+                env.get("PYTHONPATH", ""),
+                "JAX_PLATFORMS": "cpu",
+                "HVD_TPU_COORDINATOR_ADDR": f"127.0.0.1:{coord_port}",
+                "HVD_TPU_SIZE": "2",
+                "HVD_TPU_RANK": str(pid),
+                "HVD_TPU_RENDEZVOUS_ADDR": "127.0.0.1",
+                "HVD_TPU_RENDEZVOUS_PORT": str(kv_port),
+                "HVD_TPU_SCHEDULE_CHECK": "1",
+                "HVD_TPU_CHECK_CONSISTENCY": "0",
+                "HVD_TPU_STALL_CHECK_TIME_SECONDS": "1",
+                "HVD_TPU_STALL_SHUTDOWN_TIME_SECONDS": "3",
+                "HVD_TPU_FAULT_SPEC": "drill.schedule.skip:error:rank=1",
+                "HVD_TPU_FAULT_SEED": "7",
+            })
+            procs.append(subprocess.Popen(
+                [sys.executable, WORKER], env=env,
+                stdout=subprocess.PIPE, stderr=subprocess.STDOUT))
+        outs, codes = [], []
+        for p in procs:
+            out, _ = p.communicate(timeout=120)
+            outs.append(out.decode(errors="replace"))
+            codes.append(p.returncode)
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+        server.stop()
+    joined = "\n---\n".join(outs)
+    # the wedged rank must have DIAGNOSED the divergence, naming a call
+    # site, not just timed out
+    stalls = [(c, o) for c, o in zip(codes, outs) if "STALL" in o]
+    assert stalls, joined
+    assert all(c == 0 for c, _o in stalls), f"exit codes {codes}:\n{joined}"
+    assert any("schedule divergence" in o for _c, o in stalls), joined
+    assert any("dense_1" in o or "dense_2" in o for _c, o in stalls), joined
+    # the skipping rank completed its (shorter) schedule; its exit code
+    # is not asserted — the coordination service may abort it when the
+    # wedged leader exits first, which is teardown noise, not the drill
+    others = [o for o in outs if "STALL" not in o]
+    assert all("DONE" in o for o in others), joined

@@ -5,9 +5,11 @@ docs/static_analysis.md for the checker catalogue, the waiver syntax
 (``# hvd-lint: waive[checker] reason``) and the waiver budget.
 """
 
-from . import contracts, jit_purity, knobs, lock_discipline, lock_order
+from . import (contract_collectives, contracts, divergence, jit_purity,
+               knobs, lock_discipline, lock_order, mesh_axis)
 from .core import (CHECKERS, WAIVER_BUDGET, Context, Finding,  # noqa: F401
                    render_github, render_text, run, verdict)
 
 #: imported modules keep their @checker registrations alive
-ALL_CHECKERS = (lock_discipline, lock_order, contracts, jit_purity, knobs)
+ALL_CHECKERS = (lock_discipline, lock_order, contracts, jit_purity, knobs,
+                divergence, contract_collectives, mesh_axis)

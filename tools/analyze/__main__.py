@@ -27,6 +27,12 @@ def main(argv=None) -> int:
         "--root", default=core.REPO,
         help="repository root to analyze (default: this repo)")
     parser.add_argument(
+        "--paths", default="",
+        help="comma-separated repo-relative files or directories to "
+             "report findings for (default: everything) — fast "
+             "pre-commit runs; the whole tree is still parsed so "
+             "cross-file contracts stay correct")
+    parser.add_argument(
         "--hide-waived", action="store_true",
         help="omit waived findings from the report")
     parser.add_argument("--list", action="store_true",
@@ -40,7 +46,8 @@ def main(argv=None) -> int:
         return 0
 
     names = [n for n in args.checkers.split(",") if n] or None
-    ctx = core.Context(args.root)
+    paths = [p.strip() for p in args.paths.split(",") if p.strip()] or None
+    ctx = core.Context(args.root, paths=paths)
     findings, waivers = core.run(ctx, names)
     if args.format == "github":
         out = core.render_github(findings)

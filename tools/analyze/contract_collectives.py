@@ -1,0 +1,179 @@
+"""``collective-contract``: per-call-site consistency of the collective
+API surface.
+
+The eager plane validates name/shape/dtype/op *at runtime* via the
+consistency exchange (collectives.py `_check_consistency`,
+controller.cc:378-611 in the reference); this lint moves the statically
+decidable slice of that contract to CI:
+
+* **``average=`` vs ``op=`` conflict** — passing both is a runtime
+  ``ValueError`` on every rank (reference
+  ``get_average_backwards_compatibility_fun`` semantics); flag it at
+  the call site.
+* **auto-named collectives in rank-dependent loops** — a collective
+  with no ``name=`` gets a process-local sequence number
+  (``allreduce.noname.N``); inside a loop whose trip count is
+  rank-dependent the counters drift and every later auto-named
+  collective on that rank pairs with the wrong peer entry. (Collectives
+  in rank-dependent loops are *also* a divergence — the
+  ``collective-divergence`` checker owns that finding; this one fires
+  only for the auto-name aggravation.)
+* **one name, one contract** — two call sites submitting the same
+  literal ``name=`` must agree on the verb and on the ``process_set``
+  they target: the name is the cross-rank pairing key, so
+  ``allreduce('x')`` on one path and ``allgather('x')`` on another (or
+  the same name on two different process sets) is a mispair factory
+  even when each path alone is well-formed.
+"""
+
+import ast
+from typing import Dict, List, Optional, Tuple
+
+from . import spmd
+from .core import Context, Finding, checker
+
+NAME = "collective-contract"
+
+
+def _kwarg(call: ast.Call, name: str) -> Optional[ast.AST]:
+    for kw in call.keywords:
+        if kw.arg == name:
+            return kw.value
+    return None
+
+
+def _is_none(expr: Optional[ast.AST]) -> bool:
+    return expr is None or (isinstance(expr, ast.Constant)
+                            and expr.value is None)
+
+
+_OP_MEMBERS = {"Sum", "Average", "Adasum", "Min", "Max", "Product",
+               "SUM", "AVERAGE", "ADASUM", "MIN", "MAX", "PRODUCT"}
+
+
+def _definitely_set(expr: Optional[ast.AST]) -> bool:
+    """True only when the argument is statically a non-None value —
+    a literal, or a ReduceOp member reference. Wrappers forwarding
+    ``average=average, op=op`` (where at most one is non-None at
+    runtime) must not be flagged."""
+    if expr is None:
+        return False
+    if isinstance(expr, ast.Constant):
+        return expr.value is not None
+    name = expr.attr if isinstance(expr, ast.Attribute) else (
+        expr.id if isinstance(expr, ast.Name) else "")
+    return name in _OP_MEMBERS
+
+
+def _check_average_op(src, call: spmd.CollectiveCall) -> List[Finding]:
+    if call.verb not in ("allreduce", "grouped_allreduce"):
+        return []
+    avg = _kwarg(call.node, "average")
+    op = _kwarg(call.node, "op")
+    if _definitely_set(avg) and _definitely_set(op):
+        return [Finding(
+            NAME, src.rel, call.line,
+            f"{call.verb} call passes both average= and op= — the "
+            f"runtime raises ValueError on every rank (set one; op "
+            f"takes precedence in the reference API)")]
+    return []
+
+
+def _check_auto_names(src, fn: ast.AST) -> List[Finding]:
+    findings: List[Finding] = []
+    tainted = spmd.tainted_names(fn)
+    for node in spmd.walk_no_defs(fn):
+        if isinstance(node, ast.While):
+            test = node.test
+        elif isinstance(node, ast.For):
+            test = node.iter
+        else:
+            continue
+        if not spmd.is_rank_dependent(test, tainted):
+            continue
+        for sub in spmd.walk_no_defs(node):
+            call = spmd.as_collective(sub)
+            if call is None or call.verb not in spmd.NAMED_VERBS:
+                continue
+            if _kwarg(call.node, "name") is None and (
+                    len(call.node.args) < _NAME_ARG_MIN.get(call.verb, 99)):
+                findings.append(Finding(
+                    NAME, src.rel, call.line,
+                    f"auto-named {call.verb} inside a loop whose "
+                    f"iteration count is rank-dependent — the "
+                    f"process-local name counter drifts across ranks "
+                    f"and every later auto-named collective mispairs; "
+                    f"pass an explicit name="))
+    return findings
+
+
+#: positional arg count at which the name is supplied positionally
+#: (tensor, name) / (tensor, root_rank, name) / (tensor, splits, name)
+_NAME_ARG_MIN = {"allreduce": 3, "grouped_allreduce": 3,
+                 "allgather": 2, "broadcast": 3, "grouped_broadcast": 3,
+                 "alltoall": 3}
+
+
+def _name_contracts(ctx: Context) -> List[Finding]:
+    findings: List[Finding] = []
+    # collect every named site first and sort by location, so the
+    # "first" binding of a name is the earliest in the tree, not an
+    # artifact of ast.walk's breadth-first order
+    sites: List[Tuple[str, str, str, str, int]] = []
+    for src in ctx.package_files:
+        if src.tree is None:
+            continue
+        for node in src.walk():
+            call = spmd.as_collective(node)
+            if call is None or call.name is None or \
+                    call.verb not in spmd.NAMED_VERBS:
+                continue
+            pset = _kwarg(call.node, "process_set")
+            pset_key = "" if _is_none(pset) else ast.unparse(pset)
+            sites.append((call.name, call.verb, pset_key, src.rel,
+                          call.line))
+    sites.sort(key=lambda s: (s[3], s[4]))
+    #: literal name -> (verb, process_set unparse, rel, line)
+    seen: Dict[str, Tuple[str, str, str, int]] = {}
+    for cname, verb, pset_key, rel, line in sites:
+        prev = seen.get(cname)
+        if prev is None:
+            seen[cname] = (verb, pset_key, rel, line)
+            continue
+        pverb, ppset, prel, pline = prev
+        if (prel, pline) == (rel, line):
+            continue
+        if pverb != verb:
+            findings.append(Finding(
+                NAME, rel, line,
+                f"collective name {cname!r} submitted here as "
+                f"{verb} but as {pverb} at {prel}:{pline} — "
+                f"a name is the cross-rank pairing key and must "
+                f"bind one collective type"))
+        elif ppset != pset_key:
+            findings.append(Finding(
+                NAME, rel, line,
+                f"collective name {cname!r} submitted here "
+                f"with process_set={pset_key or 'default'} but "
+                f"with process_set={ppset or 'default'} at "
+                f"{prel}:{pline} — mixed process sets under one "
+                f"name mispair across ranks"))
+    return findings
+
+
+@checker(NAME)
+def run(ctx: Context) -> List[Finding]:
+    findings: List[Finding] = []
+    for src in ctx.package_files:
+        if src.tree is None:
+            continue
+        for node in src.walk():
+            call = spmd.as_collective(node)
+            if call is not None:
+                findings.extend(_check_average_op(src, call))
+        for fn in [n for n in src.walk()
+                   if isinstance(n, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef))]:
+            findings.extend(_check_auto_names(src, fn))
+    findings.extend(_name_contracts(ctx))
+    return findings

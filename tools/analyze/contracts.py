@@ -31,14 +31,6 @@ _SPEC_ENTRY = re.compile(
     r"(:[A-Za-z0-9_.=-]+)*$")
 
 
-def _parent_map(tree: ast.AST) -> Dict[ast.AST, ast.AST]:
-    parents = {}
-    for node in ast.walk(tree):
-        for child in ast.iter_child_nodes(node):
-            parents[child] = node
-    return parents
-
-
 def _expand_site(arg: ast.AST, parents: Dict[ast.AST, ast.AST]
                  ) -> Optional[List[str]]:
     """Site names from a FaultPoint's first argument. Handles the
@@ -89,8 +81,8 @@ def _fault_sites(ctx: Context) -> List[Tuple[str, str, int]]:
     for src in ctx.package_files:
         if src.tree is None or src.rel.endswith("faults.py"):
             continue
-        parents = _parent_map(src.tree)
-        for node in ast.walk(src.tree):
+        parents = src.parents()
+        for node in src.walk():
             if not isinstance(node, ast.Call):
                 continue
             fn = node.func
@@ -115,7 +107,7 @@ def tested_spec_sites(ctx: Context) -> Set[str]:
     for src in ctx.test_files:
         if src.tree is None:
             continue
-        for node in ast.walk(src.tree):
+        for node in src.walk():
             if isinstance(node, ast.Constant) and \
                     isinstance(node.value, str) and ":" in node.value:
                 for entry in node.value.split(";"):
@@ -196,7 +188,7 @@ def _registrations(src) -> List[Tuple[str, Tuple[str, ...], int, str]]:
     call; bound_var is the module-level variable it is assigned to
     ('' when unbound)."""
     out = []
-    for node in ast.walk(src.tree):
+    for node in src.walk():
         target = ""
         call = None
         if isinstance(node, ast.Assign) and \
@@ -266,7 +258,7 @@ def run_metrics(ctx: Context) -> List[Finding]:
                     f"metric {name!r} is not documented in "
                     f"docs/metrics.md — add a table row"))
         # label-set consistency at .labels(...) call sites
-        for node in ast.walk(src.tree):
+        for node in src.walk():
             if not isinstance(node, ast.Call):
                 continue
             fn = node.func

@@ -75,7 +75,9 @@ class Waiver:
 
 class SourceFile:
     """One parsed python file: text, lines, AST (None on syntax error)
-    and its inline waivers."""
+    and its inline waivers. The node list and parent map are computed
+    lazily and cached, so the nine checkers share one traversal per file
+    instead of each re-walking the tree."""
 
     def __init__(self, path: str, rel: str):
         self.path = path
@@ -88,6 +90,8 @@ class SourceFile:
                                                      filename=rel)
         except SyntaxError:
             self.tree = None
+        self._nodes: Optional[List[ast.AST]] = None
+        self._parents: Optional[Dict[ast.AST, ast.AST]] = None
         self.waivers: List[Waiver] = []
         for lineno, line in enumerate(self.lines, 1):
             m = _WAIVE_RE.search(line)
@@ -95,12 +99,40 @@ class SourceFile:
                 self.waivers.append(
                     Waiver(m.group(1), m.group(2), rel, lineno))
 
+    def walk(self) -> List[ast.AST]:
+        """Every AST node of this file, in ``ast.walk`` order (cached)."""
+        if self._nodes is None:
+            self._nodes = list(ast.walk(self.tree)) \
+                if self.tree is not None else []
+        return self._nodes
+
+    def parents(self) -> Dict[ast.AST, ast.AST]:
+        """child node -> parent node for the whole tree (cached)."""
+        if self._parents is None:
+            parents: Dict[ast.AST, ast.AST] = {}
+            for node in self.walk():
+                for child in ast.iter_child_nodes(node):
+                    parents[child] = node
+            self._parents = parents
+        return self._parents
+
 
 class Context:
-    """Everything a checker may look at, parsed once and shared."""
+    """Everything a checker may look at, parsed once and shared.
 
-    def __init__(self, root: str = REPO):
+    ``paths`` (repo-relative files or directory prefixes) restricts
+    which files *findings are reported for* (``--paths``, fast
+    pre-commit runs). The whole tree is still parsed and every checker
+    still sees it — cross-file contracts (seeded-test harvests,
+    declared mesh axes, one-name-one-contract) must be evaluated
+    against the full repo or a subset run would fabricate findings a
+    full run does not have."""
+
+    def __init__(self, root: str = REPO,
+                 paths: Optional[List[str]] = None):
         self.root = root
+        self.paths = [os.path.normpath(p) for p in paths] if paths \
+            else None
         self.package_files = self._collect(os.path.join(root, "horovod_tpu"))
         self.test_files = self._collect(os.path.join(root, "tests"))
         self.docs = {}
@@ -127,6 +159,15 @@ class Context:
                 out.append(SourceFile(
                     path, os.path.relpath(path, self.root)))
         return out
+
+    def selected(self, rel: str) -> bool:
+        """Is this repo-relative path inside the ``--paths`` selection
+        (always True with no selection)?"""
+        if self.paths is None:
+            return True
+        rel = os.path.normpath(rel)
+        return any(rel == p or rel.startswith(p + os.sep)
+                   for p in self.paths)
 
     def module_name(self, src: SourceFile) -> str:
         """Dotted module path for a package file
@@ -163,12 +204,18 @@ def apply_waivers(findings: List[Finding],
     that is clean under a full run."""
     by_loc: Dict[Tuple[str, int], List[Waiver]] = {}
     all_waivers: List[Waiver] = []
+    last_line: Dict[str, int] = {}
     for src in files:
+        last_line[src.rel] = len(src.lines)
         for w in src.waivers:
             all_waivers.append(w)
-            # a waiver covers its own line and the line below it
+            # a waiver covers its own line and the line below it — except
+            # on the last line of a file, where no line below exists (the
+            # off-by-one would otherwise register phantom coverage one
+            # past EOF)
             by_loc.setdefault((w.path, w.line), []).append(w)
-            by_loc.setdefault((w.path, w.line + 1), []).append(w)
+            if w.line < len(src.lines):
+                by_loc.setdefault((w.path, w.line + 1), []).append(w)
     for f in findings:
         for w in by_loc.get((f.path, f.line), ()):
             if w.checker == f.checker and w.reason:
@@ -184,11 +231,16 @@ def apply_waivers(findings: List[Finding],
                 f"waive[{w.checker}] carries no reason — every waiver "
                 f"must say why the finding is acceptable"))
         elif not w.used and (ran is None or w.checker in ran):
+            hint = ""
+            if w.line >= last_line.get(w.path, w.line + 1):
+                hint = (" (note: this waiver sits on the last line of "
+                        "the file, so it can only cover its own line — "
+                        "there is no line below)")
             extra.append(Finding(
                 "waiver", w.path, w.line,
                 f"stale waiver: waive[{w.checker}] suppresses nothing "
                 f"here — remove it (stale waivers hide future "
-                f"regressions at this line)"))
+                f"regressions at this line){hint}"))
     return findings + extra
 
 
@@ -210,6 +262,8 @@ def run(ctx: Optional[Context] = None,
     findings = apply_waivers(findings,
                              ctx.package_files + ctx.test_files,
                              ran=set(names))
+    if ctx.paths is not None:
+        findings = [f for f in findings if ctx.selected(f.path)]
     findings.sort(key=lambda f: (f.path, f.line, f.checker))
     live = [w for src in ctx.package_files + ctx.test_files
             for w in src.waivers if w.used]

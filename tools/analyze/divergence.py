@@ -1,0 +1,152 @@
+"""``collective-divergence``: rank-dependent control flow around
+collectives.
+
+Horovod's C++ core exists largely to defend against one failure class:
+ranks that submit *different* collective sequences silently deadlock
+(controller.cc's negotiation + the stall inspector are the reference's
+runtime mitigations). In the compiled SPMD world the hang is even more
+silent — mispaired programs can complete with wrong data before the
+missing partner wedges a later step. This checker moves the two
+canonical shapes of that bug to CI:
+
+* **diverging branch arms** — an ``if``/``while``/``for`` guarded by a
+  rank-dependent condition (``hvd.rank()``, ``jax.process_index()``,
+  ``.my_index``/``.is_member``, or a name tainted by one) whose arms
+  submit *different* collective sequences: some ranks run one sequence,
+  the rest another, and the mismatch wedges every rank at the first
+  unpaired call;
+* **rank-dependent early exits** — a rank-dependent guard that
+  ``return``/``raise``/``continue``/``break``s out while collectives
+  are submitted further down the same flow: the exiting ranks skip a
+  collective the others will wait on forever.
+
+Branches whose arms submit *identical* sequences (e.g. zero-vs-real
+contributions around one allreduce) are correct SPMD and stay silent,
+as do rank guards around pure host work (logging, checkpoint writes).
+The runtime complement is the collective schedule ledger
+(``horovod_tpu/_schedule.py``, ``HVD_TPU_SCHEDULE_CHECK``), which
+catches the dynamic cases no lint can see — see
+docs/static_analysis.md.
+"""
+
+import ast
+from typing import List, Optional, Set
+
+from . import spmd
+from .core import Context, Finding, checker
+
+NAME = "collective-divergence"
+
+
+def _fmt_seq(seq) -> str:
+    if not seq:
+        return "(none)"
+    return ", ".join(f"{v}({n!r})" if n is not None else v
+                     for v, n in seq[:4]) + (", ..." if len(seq) > 4 else "")
+
+
+def _check_function(src, fn: ast.AST) -> List[Finding]:
+    findings: List[Finding] = []
+    tainted = spmd.tainted_names(fn)
+    reported: Set[int] = set()
+
+    # collectives by line, for the early-exit rule ("submitted below")
+    calls = spmd.collective_calls(fn)
+
+    # innermost-first (reversed pre-order puts descendants before
+    # ancestors): once a nested rank-dependent construct is reported,
+    # the enclosing one sequences around it instead of re-reporting the
+    # same collectives
+    ctrl = [n for n in spmd.walk_no_defs(fn)
+            if isinstance(n, (ast.If, ast.While, ast.For))]
+    for node in reversed(ctrl):
+        test = node.iter if isinstance(node, ast.For) else node.test
+        if not spmd.is_rank_dependent(test, tainted):
+            continue
+        if isinstance(node, (ast.While, ast.For)):
+            # a rank-dependent iteration count: every collective inside
+            # runs a different number of times per rank
+            inside = spmd.collective_sequence(node.body, skip=reported)
+            if inside:
+                findings.append(Finding(
+                    NAME, src.rel, node.lineno,
+                    f"collective(s) [{_fmt_seq(inside)}] inside a loop "
+                    f"whose iteration count is rank-dependent — ranks "
+                    f"submit different numbers of collectives and "
+                    f"deadlock at the first unpaired call"))
+                reported.add(id(node))
+            continue
+        body_seq = spmd.collective_sequence(node.body, skip=reported)
+        else_seq = spmd.collective_sequence(node.orelse, skip=reported)
+        if body_seq != else_seq:
+            findings.append(Finding(
+                NAME, src.rel, node.lineno,
+                f"collective sequence diverges across ranks: this "
+                f"branch is guarded by a rank-dependent condition and "
+                f"its arms submit different collectives "
+                f"([{_fmt_seq(body_seq)}] vs [{_fmt_seq(else_seq)}]) — "
+                f"ranks taking different arms deadlock at the first "
+                f"unpaired call"))
+            reported.add(id(node))
+            continue
+        # arms agree (possibly both empty): a one-sided early exit still
+        # skips everything submitted after the branch
+        exits = [(arm, spmd.ends_in_exit(arm))
+                 for arm in (node.body, node.orelse)]
+        exiting = [(arm, kind) for arm, kind in exits if kind]
+        if len(exiting) != 1:
+            continue  # neither arm exits, or both do (all ranks leave)
+        end = getattr(node, "end_lineno", node.lineno)
+        below = [c for c in calls if c.line > end]
+        if below:
+            arm, kind = exiting[0]
+            findings.append(Finding(
+                NAME, src.rel, node.lineno,
+                f"rank-dependent early {kind} skips collective(s) "
+                f"submitted below "
+                f"([{_fmt_seq([(c.verb, c.name) for c in below])}], "
+                f"first at line {below[0].line}) — the exiting ranks "
+                f"never submit them and the others wait forever"))
+            reported.add(id(node))
+    return findings
+
+
+def _rank_guarded_assert(src, fn: ast.AST,
+                         tainted: Optional[Set[str]] = None
+                         ) -> List[Finding]:
+    """``assert rank() == 0`` style statements inside functions that
+    also submit collectives: an AssertionError on a subset of ranks is
+    an early exit by another name."""
+    findings: List[Finding] = []
+    tainted = tainted if tainted is not None else spmd.tainted_names(fn)
+    calls = spmd.collective_calls(fn)
+    if not calls:
+        return findings
+    for node in spmd.walk_no_defs(fn):
+        if isinstance(node, ast.Assert) and \
+                spmd.is_rank_dependent(node.test, tainted):
+            below = [c for c in calls if c.line > node.lineno]
+            if below:
+                findings.append(Finding(
+                    NAME, src.rel, node.lineno,
+                    f"rank-dependent assert above collective(s) "
+                    f"([{_fmt_seq([(c.verb, c.name) for c in below])}]) "
+                    f"— ranks failing the assert skip them and the "
+                    f"others wait forever"))
+    return findings
+
+
+@checker(NAME)
+def run(ctx: Context) -> List[Finding]:
+    findings: List[Finding] = []
+    for src in ctx.package_files:
+        if src.tree is None:
+            continue
+        for fn in [n for n in src.walk()
+                   if isinstance(n, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef))]:
+            tainted = spmd.tainted_names(fn)
+            findings.extend(_check_function(src, fn))
+            findings.extend(_rank_guarded_assert(src, fn,
+                                                 tainted=tainted))
+    return findings

@@ -46,18 +46,15 @@ def _is_jit_func(fn: ast.AST) -> bool:
     return False
 
 
-def _local_defs(scope: ast.AST) -> Dict[str, ast.AST]:
-    out = {}
-    for node in ast.walk(scope):
-        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
-            out.setdefault(node.name, node)
-    return out
-
-
-def find_traced(tree: ast.AST) -> List[ast.AST]:
-    """Function/Lambda nodes that get jit-traced in this module."""
+def find_traced(src) -> List[ast.AST]:
+    """Function/Lambda nodes that get jit-traced in this module.
+    ``src`` is a :class:`core.SourceFile` (its cached node walk is
+    shared with the other checkers)."""
     traced: List[ast.AST] = []
-    defs = _local_defs(tree)
+    defs: Dict[str, ast.AST] = {}
+    for node in src.walk():
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            defs.setdefault(node.name, node)
     seen: Set[int] = set()
 
     def add(node: Optional[ast.AST]) -> None:
@@ -65,7 +62,7 @@ def find_traced(tree: ast.AST) -> List[ast.AST]:
             seen.add(id(node))
             traced.append(node)
 
-    for node in ast.walk(tree):
+    for node in src.walk():
         if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
             for deco in node.decorator_list:
                 if _is_jit_func(deco):
@@ -202,6 +199,6 @@ def run(ctx: Context) -> List[Finding]:
     for src in ctx.package_files:
         if src.tree is None:
             continue
-        for fn in find_traced(src.tree):
+        for fn in find_traced(src):
             findings.extend(_check_traced(src, fn))
     return findings

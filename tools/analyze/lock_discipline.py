@@ -273,7 +273,7 @@ def run(ctx: Context) -> List[Finding]:
     for src in ctx.package_files:
         if src.tree is None:
             continue
-        for cls in [n for n in ast.walk(src.tree)
+        for cls in [n for n in src.walk()
                     if isinstance(n, ast.ClassDef)]:
             findings.extend(_check_class(src, cls))
     return findings

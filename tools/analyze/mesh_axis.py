@@ -1,0 +1,202 @@
+"""``mesh-axis``: literal axis names resolve against a declared mesh.
+
+A typo'd axis name passed to ``psum``/``shard_map``/``ppermute``-style
+calls is a *runtime* ``NameError`` deep inside a trace at best and a
+silently wrong reduction at worst (an axis XLA does not know simply is
+not reduced over in some jax versions' fallback paths). Mesh axes are
+declared in a handful of places — ``Mesh(devices, ("dp", ...))``
+constructions, ``AXIS_ORDER``-style module constants, ``axis_name``
+parameter defaults, ``shard_map(..., axis_names={...})`` — so the lint
+collects every declaration in the package and checks each *literal*
+axis argument at a collective-primitive call site against that set.
+Axis names carried in variables are the runtime's job; literals are
+decidable here.
+
+Second rule: **axis order**. The training mesh's axis order encodes
+interconnect locality (``parallel/mesh_utils.py`` ``AXIS_ORDER``:
+outer = DCN, inner = ICI), and pipeline/MoE stages compose through
+shared ``PartitionSpec``s — a spec whose axes are all drawn from
+``AXIS_ORDER`` but listed in a different relative order shards one
+stage's tensors against a transposed mesh and mispairs its collectives
+with its neighbors'. Literal axis tuples (in PartitionSpecs and
+multi-axis collective calls) must preserve the declared relative order.
+"""
+
+import ast
+import re
+from typing import Dict, List, Optional, Set, Tuple
+
+from . import spmd
+from .core import Context, Finding, checker
+
+NAME = "mesh-axis"
+
+#: callee terminal names whose 2nd positional argument names the axis
+#: (or axes) being communicated over
+_AXIS_ARG1 = {"psum", "pmean", "pmin", "pmax", "ppermute", "all_gather",
+              "all_to_all", "psum_scatter", "pbroadcast", "pcast",
+              "all_gather_in_jit", "reduce_scatter_in_jit",
+              "all_to_all_in_jit"}
+
+#: callees whose FIRST positional argument is the axis
+#: (``jax.lax.axis_index(name)`` / ``axis_size(name)``)
+_AXIS_ARG0 = {"axis_index", "axis_size"}
+
+#: kwarg names that carry an axis name wherever they appear
+_AXIS_KWARGS = ("axis_name", "inner_axis", "outer_axis")
+
+#: module-level constant names that declare an axis inventory
+_DECL_NAME = re.compile(r"(AXIS|AXES)", re.IGNORECASE)
+
+_SPEC_CALLEES = {"PartitionSpec", "P", "Spec"}
+
+
+def _string_elts(expr: ast.AST) -> Optional[List[str]]:
+    """The string elements of a literal str / tuple / list / set of
+    strings, else None."""
+    if isinstance(expr, ast.Constant) and isinstance(expr.value, str):
+        return [expr.value]
+    if isinstance(expr, (ast.Tuple, ast.List, ast.Set)):
+        out = []
+        for e in expr.elts:
+            if not (isinstance(e, ast.Constant)
+                    and isinstance(e.value, str)):
+                return None
+            out.append(e.value)
+        return out
+    return None
+
+
+def declared_axes(ctx: Context) -> Tuple[Set[str], Tuple[str, ...]]:
+    """(all declared axis names, the AXIS_ORDER-style canonical order).
+
+    Declarations collected package-wide:
+    * axis tuples of ``Mesh(devices, (...))`` constructions;
+    * module constants whose name mentions AXIS/AXES bound to a string
+      or tuple of strings (``AXIS_ORDER``, ``PROC_AXIS``);
+    * string defaults of ``axis_name``/``*_axis`` parameters.
+    ``shard_map(..., axis_names={...})`` sets are usages, not
+    declarations: they must resolve against a declared mesh.
+    """
+    axes: Set[str] = set()
+    order: Tuple[str, ...] = ()
+    for src in ctx.package_files:
+        if src.tree is None:
+            continue
+        for node in src.walk():
+            if isinstance(node, ast.Assign):
+                names = [t.id for t in node.targets
+                         if isinstance(t, ast.Name)]
+                if names and any(_DECL_NAME.search(n) for n in names):
+                    elts = _string_elts(node.value)
+                    if elts:
+                        axes.update(elts)
+                        if len(elts) > 1 and any(
+                                "ORDER" in n.upper() for n in names):
+                            order = tuple(elts)
+            elif isinstance(node, ast.Call):
+                callee = spmd.terminal_name(node.func)
+                if callee == "Mesh" and len(node.args) >= 2:
+                    elts = _string_elts(node.args[1])
+                    if elts:
+                        axes.update(elts)
+                # NOTE: shard_map(..., axis_names={...}) is deliberately
+                # NOT a declaration — it *binds* axes for the inner fn
+                # but must itself resolve against a mesh; collecting it
+                # here would let the typo'd site whitelist its own typo
+                # package-wide (checked as a usage in _axis_literals_at)
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                args = node.args
+                # align trailing defaults to trailing positional args
+                pos_with_defaults = list(zip(
+                    args.args[len(args.args) - len(args.defaults):],
+                    args.defaults)) + [
+                    (a, d) for a, d in zip(args.kwonlyargs,
+                                           args.kw_defaults)
+                    if d is not None]
+                for arg, default in pos_with_defaults:
+                    if (arg.arg == "axis_name"
+                            or arg.arg.endswith("_axis")
+                            or arg.arg == "axis_names"):
+                        elts = _string_elts(default)
+                        if elts:
+                            axes.update(elts)
+    return axes, order
+
+
+def _axis_literals_at(call: ast.Call) -> List[Tuple[str, ast.AST]]:
+    """Literal axis names this call passes, with the expression they
+    came from (for the order rule a tuple literal is one unit)."""
+    callee = spmd.terminal_name(call.func)
+    exprs: List[ast.AST] = []
+    if callee in _AXIS_ARG1 and len(call.args) >= 2:
+        exprs.append(call.args[1])
+    if callee in _AXIS_ARG0 and call.args:
+        exprs.append(call.args[0])
+    for kw in call.keywords:
+        if kw.arg in _AXIS_KWARGS or kw.arg == "axis_names":
+            exprs.append(kw.value)
+    flat: List[Tuple[str, ast.AST]] = []
+    for expr in exprs:
+        elts = _string_elts(expr)
+        if elts:
+            for name in elts:
+                flat.append((name, expr))
+    return flat
+
+
+def _order_violation(elts: List[str],
+                     order: Tuple[str, ...]) -> bool:
+    if len(elts) < 2 or not order:
+        return False
+    if not all(e in order for e in elts):
+        return False
+    idx = [order.index(e) for e in elts]
+    return idx != sorted(idx)
+
+
+@checker(NAME)
+def run(ctx: Context) -> List[Finding]:
+    findings: List[Finding] = []
+    axes, order = declared_axes(ctx)
+    for src in ctx.package_files:
+        if src.tree is None:
+            continue
+        for node in src.walk():
+            if not isinstance(node, ast.Call):
+                continue
+            callee = spmd.terminal_name(node.func)
+            # undeclared literal axis at a collective-primitive site
+            for axis, expr in _axis_literals_at(node):
+                if axis not in axes:
+                    findings.append(Finding(
+                        NAME, src.rel, node.lineno,
+                        f"axis {axis!r} passed to {callee}() is not "
+                        f"declared by any mesh/axis context in the "
+                        f"package (declared: "
+                        f"{sorted(axes) or ['<none>']}) — a typo'd "
+                        f"axis fails only at trace time, inside the "
+                        f"compiled step"))
+            # axis-order agreement for literal multi-axis tuples
+            check_order: List[ast.AST] = []
+            if callee in _SPEC_CALLEES:
+                check_order.extend(node.args)
+            if callee in _AXIS_ARG1 and len(node.args) >= 2:
+                check_order.append(node.args[1])
+            if callee in _AXIS_ARG0 and node.args:
+                check_order.append(node.args[0])
+            seen_ids: Set[int] = set()
+            for expr in check_order:
+                if id(expr) in seen_ids:
+                    continue
+                seen_ids.add(id(expr))
+                elts = _string_elts(expr)
+                if elts and _order_violation(elts, order):
+                    findings.append(Finding(
+                        NAME, src.rel, node.lineno,
+                        f"axis tuple {tuple(elts)} disagrees with the "
+                        f"declared mesh axis order {order} — "
+                        f"pipeline/MoE stages sharding against a "
+                        f"transposed order mispair their collectives; "
+                        f"list axes outermost-first as declared"))
+    return findings

@@ -1,0 +1,227 @@
+"""Shared SPMD call-graph utility for the distributed-semantics checkers.
+
+The ``collective-divergence``, ``collective-contract`` and ``mesh-axis``
+checkers all reason about the same two things:
+
+* **collective call sites** — where this function submits a collective
+  (eager verbs, grouped verbs, the in-jit wrappers, ``jax.lax``
+  primitives), normalized to a canonical verb plus the literal ``name=``
+  when one is statically visible;
+* **rank dependence** — whether an expression's value can differ across
+  processes (``hvd.rank()``, ``jax.process_index()``, process-set
+  membership), including one level of local taint (``r = hvd.rank()``
+  then ``if r == 0:``).
+
+Both are extracted here once per function so the three checkers share
+one walk instead of re-deriving the call graph independently (the same
+economy :class:`core.SourceFile`'s cached node walk buys file-level).
+"""
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+#: terminal callee name -> canonical verb, for every way this package
+#: submits a collective. Eager verbs and their *_async twins collapse to
+#: one verb: a rank submitting allreduce_async where another submits
+#: allreduce is NOT a divergence.
+COLLECTIVE_VERBS: Dict[str, str] = {
+    "allreduce": "allreduce", "allreduce_async": "allreduce",
+    "grouped_allreduce": "grouped_allreduce",
+    "grouped_allreduce_async": "grouped_allreduce",
+    "allgather": "allgather", "allgather_async": "allgather",
+    "broadcast": "broadcast", "broadcast_async": "broadcast",
+    "grouped_broadcast": "grouped_broadcast",
+    "grouped_broadcast_async": "grouped_broadcast",
+    "alltoall": "alltoall", "alltoall_async": "alltoall",
+    "barrier": "barrier", "join_round": "join_round",
+    # object/pytree helpers (functions.py) — each submits collectives
+    "broadcast_parameters": "broadcast",
+    "broadcast_optimizer_state": "broadcast",
+    "broadcast_object": "broadcast", "allgather_object": "allgather",
+    "broadcast_variables": "broadcast",
+    "broadcast_global_variables": "broadcast",
+    # in-jit wrappers (collectives.py) + jax.lax primitives
+    "psum": "psum", "pmean": "pmean", "pmin": "pmin", "pmax": "pmax",
+    "psum_scatter": "psum_scatter", "ppermute": "ppermute",
+    "all_gather": "all_gather", "all_to_all": "all_to_all",
+    "all_gather_in_jit": "all_gather",
+    "reduce_scatter_in_jit": "psum_scatter",
+    "all_to_all_in_jit": "all_to_all",
+}
+
+#: verbs that carry a user-visible tensor name (eager plane); the in-jit
+#: primitives are anonymous by design
+NAMED_VERBS = {"allreduce", "grouped_allreduce", "allgather", "broadcast",
+               "grouped_broadcast", "alltoall"}
+
+#: method/function calls whose result is this process's identity
+_RANK_CALLS = {"rank", "process_index", "local_rank", "cross_rank",
+               "process_id"}
+#: attribute reads that are per-process identity / membership
+_RANK_ATTRS = {"my_index", "is_member"}
+
+
+def terminal_name(fn: ast.AST) -> str:
+    """``foo`` for ``foo(...)``, ``bar`` for ``a.b.bar(...)``."""
+    if isinstance(fn, ast.Attribute):
+        return fn.attr
+    if isinstance(fn, ast.Name):
+        return fn.id
+    return ""
+
+
+class CollectiveCall:
+    """One collective submission site."""
+
+    __slots__ = ("node", "verb", "name", "line")
+
+    def __init__(self, node: ast.Call, verb: str, name: Optional[str]):
+        self.node = node
+        self.verb = verb
+        #: literal ``name=`` value when statically visible, else None
+        self.name = name
+        self.line = node.lineno
+
+    def describe(self) -> str:
+        return f"{self.verb}({self.name!r})" if self.name is not None \
+            else self.verb
+
+
+def as_collective(node: ast.AST) -> Optional[CollectiveCall]:
+    """A :class:`CollectiveCall` when ``node`` submits a collective."""
+    if not isinstance(node, ast.Call):
+        return None
+    verb = COLLECTIVE_VERBS.get(terminal_name(node.func))
+    if verb is None:
+        return None
+    name = None
+    for kw in node.keywords:
+        if kw.arg == "name" and isinstance(kw.value, ast.Constant) \
+                and isinstance(kw.value.value, str):
+            name = kw.value.value
+    return CollectiveCall(node, verb, name)
+
+
+def functions(tree: ast.AST) -> List[ast.AST]:
+    """Every function/method definition in the module."""
+    return [n for n in ast.walk(tree)
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]
+
+
+def tainted_names(fn: ast.AST) -> Set[str]:
+    """Local names assigned from a rank-dependent expression anywhere in
+    ``fn`` (one level of taint — ``r = hvd.rank()`` / ``me = r``).
+    Memoized on the node: the three distributed-semantics checkers all
+    ask for the same function's taint set."""
+    cached = getattr(fn, "_spmd_tainted", None)
+    if cached is not None:
+        return cached
+    tainted: Set[str] = set()
+    # two passes so a chained alias assigned before its source is still
+    # caught in simple top-down code; deeper flow analysis is the
+    # runtime ledger's job, not a lint's
+    for _ in range(2):
+        for node in ast.walk(fn):
+            if not isinstance(node, (ast.Assign, ast.AnnAssign,
+                                     ast.AugAssign, ast.NamedExpr)):
+                continue
+            value = node.value
+            if value is None or not is_rank_dependent(value, tainted):
+                continue
+            targets = node.targets if isinstance(node, ast.Assign) \
+                else [node.target]
+            for tgt in targets:
+                for leaf in ast.walk(tgt):
+                    if isinstance(leaf, ast.Name):
+                        tainted.add(leaf.id)
+    fn._spmd_tainted = tainted
+    return tainted
+
+
+def is_rank_dependent(expr: ast.AST,
+                      tainted: Optional[Set[str]] = None) -> bool:
+    """Can this expression's value differ across ranks?  Conservative in
+    the *under*-flagging direction: only explicit identity reads
+    (``*.rank()``, ``*.process_index()``, ``.my_index``/``.is_member``)
+    and names tainted by them count — world-size or data-driven
+    conditions (identical on every rank in correct SPMD code) do not.
+    """
+    tainted = tainted or set()
+    for node in ast.walk(expr):
+        if isinstance(node, ast.Call) and \
+                terminal_name(node.func) in _RANK_CALLS:
+            return True
+        if isinstance(node, ast.Attribute) and node.attr in _RANK_ATTRS:
+            return True
+        if isinstance(node, ast.Name) and isinstance(node.ctx, ast.Load) \
+                and node.id in tainted:
+            return True
+    return False
+
+
+def collective_sequence(stmts, skip: Optional[Set[int]] = None
+                        ) -> List[Tuple[str, Optional[str]]]:
+    """The ordered (verb, literal-name) sequence a list of statements
+    submits. Does not descend into nested function/class definitions
+    (they run on their own schedule); ``skip`` is a set of node ids to
+    exclude (e.g. a nested rank-dependent branch already reported)."""
+    out: List[Tuple[str, Optional[str]]] = []
+    for stmt in stmts:
+        if skip and id(stmt) in skip:
+            continue  # an already-reported nested construct
+        for node in walk_no_defs(stmt, skip):
+            call = as_collective(node)
+            if call is not None:
+                out.append((call.verb, call.name))
+    return out
+
+
+def walk_no_defs(root: ast.AST,
+                 skip: Optional[Set[int]] = None) -> List[ast.AST]:
+    """Pre-order ``ast.walk`` (source order preserved) that stops at
+    nested function/class definitions (the root itself may be a def)
+    and at nodes listed in ``skip``."""
+    out: List[ast.AST] = []
+
+    def rec(node: ast.AST) -> None:
+        if skip and id(node) in skip and node is not root:
+            return
+        out.append(node)
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.ClassDef, ast.Lambda)):
+                continue
+            rec(child)
+
+    rec(root)
+    return out
+
+
+def collective_calls(fn: ast.AST) -> List[CollectiveCall]:
+    """Every collective submission lexically inside ``fn`` (nested defs
+    excluded). Memoized on the node, like :func:`tainted_names`."""
+    cached = getattr(fn, "_spmd_calls", None)
+    if cached is not None:
+        return cached
+    out = []
+    for node in walk_no_defs(fn):
+        call = as_collective(node)
+        if call is not None:
+            out.append(call)
+    fn._spmd_calls = out
+    return out
+
+
+def ends_in_exit(stmts) -> Optional[str]:
+    """'return'/'raise'/'continue'/'break' when the branch arm
+    unconditionally leaves the enclosing flow, else None."""
+    for stmt in stmts:
+        if isinstance(stmt, ast.Return):
+            return "return"
+        if isinstance(stmt, ast.Raise):
+            return "raise"
+        if isinstance(stmt, ast.Continue):
+            return "continue"
+        if isinstance(stmt, ast.Break):
+            return "break"
+    return None
