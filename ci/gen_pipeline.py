@@ -37,11 +37,13 @@ COMMON_SUITES = [
     ("unit",
      "python -m pytest tests/ -q -m 'not integration and not chaos' "
      "--ignore=tests/test_checkpointing.py "
-     "--ignore=tests/test_serving.py", 30),
+     "--ignore=tests/test_serving.py "
+     "--ignore=tests/test_generation.py", 30),
     ("chaos", "python -m pytest tests/ -q -m chaos "
      "--ignore=tests/test_coordinator_recovery.py "
      "--ignore=tests/test_checkpointing.py "
-     "--ignore=tests/test_serving.py", 20),
+     "--ignore=tests/test_serving.py "
+     "--ignore=tests/test_generation.py", 20),
     # coordinator-kill + heartbeat-timeout drills, seeded so every run
     # replays the same fault schedule; owns its test file exclusively
     # (the generic chaos suite ignores it to avoid double runs)
@@ -61,6 +63,13 @@ COMMON_SUITES = [
     ("serving",
      "env HVD_TPU_FAULT_SEED=1234 "
      "python -m pytest tests/test_serving.py -q", 20),
+    # continuous-batching generation: paged KV cache, decode/full-forward
+    # parity, preemption, and the seeded prefill/decode/evict chaos
+    # drills — pinned seed; owns its file exclusively (unit+chaos+serving
+    # suites ignore it)
+    ("serving-gen",
+     "env HVD_TPU_FAULT_SEED=1234 "
+     "python -m pytest tests/test_generation.py -q", 20),
     ("multiproc",
      "python -m pytest tests/test_multiprocess_integration.py -q", 30),
     ("elastic", "python -m pytest tests/test_elastic_e2e.py -q", 40),

@@ -1,0 +1,93 @@
+"""Serve autoregressive generation with continuous batching.
+
+The generation-side counterpart of examples/jax_serving.py: a
+"training" step commits a toy transformer checkpoint; this process
+restores it into a GenerationEngine (paged KV cache + iteration-level
+scheduler) and serves prompts — streaming tokens for one request while
+a burst of concurrent mixed-length requests shares the re-formed
+decode batch.
+
+Run: python examples/jax_generation.py [--prompt-len 6] [--max-tokens 12]
+"""
+
+import argparse
+import tempfile
+import threading
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from horovod_tpu import checkpointing
+from horovod_tpu import metrics
+from horovod_tpu.models import Transformer, TransformerConfig
+from horovod_tpu.serving import GenerationEngine
+
+CFG = TransformerConfig(vocab_size=256, num_layers=2, d_model=64,
+                        num_heads=2, head_dim=32, max_seq_len=128,
+                        dtype=jnp.float32)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--prompt-len", type=int, default=6)
+    ap.add_argument("--max-tokens", type=int, default=12)
+    args = ap.parse_args()
+
+    model = Transformer(CFG)
+    rng = np.random.RandomState(0)
+    params = model.init(jax.random.PRNGKey(0),
+                        jnp.zeros((1, 4), jnp.int32))
+
+    with tempfile.TemporaryDirectory() as ckpt_dir:
+        # "training" commits step 1; generation restores it
+        checkpointing.save(ckpt_dir, 1, params)
+        with GenerationEngine(model, checkpoint_dir=ckpt_dir,
+                              block_size=8, num_blocks=65, max_seqs=4,
+                              prefill_chunk=16,
+                              reload_poll_seconds=0) as engine:
+            print(f"serving checkpoint step {engine.step}")
+
+            # one request, streamed: tokens print as the scheduler
+            # emits them, not when the sequence completes
+            prompt = rng.randint(0, CFG.vocab_size,
+                                 (args.prompt_len,)).tolist()
+            print(f"prompt: {prompt}\nstream:", end=" ", flush=True)
+            for tok in engine.stream(prompt, max_tokens=args.max_tokens,
+                                     timeout=300):
+                print(tok, end=" ", flush=True)
+            print()
+
+            # a concurrent mixed-length burst: more requests than batch
+            # slots, finishing at different lengths — the continuous
+            # batcher re-forms the running batch every decode step
+            lens = [3 + 2 * (i % 4) for i in range(8)]
+            outs = [None] * len(lens)
+
+            def client(i):
+                p = rng.randint(0, CFG.vocab_size, (4,)).tolist()
+                outs[i] = engine.generate(p, max_tokens=lens[i],
+                                          timeout=300)
+
+            threads = [threading.Thread(target=client, args=(i,))
+                       for i in range(len(lens))]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            assert [len(o) for o in outs] == lens, outs
+
+            snap = metrics.snapshot()
+            occ = snap["hvd_tpu_gen_batch_occupancy"]
+            decoded = int(snap['hvd_tpu_gen_tokens_total{phase="decode"}'])
+            print(f"generated {decoded} tokens in {int(occ['count'])} "
+                  f"decode steps (avg occupancy "
+                  f"{occ['sum'] / max(1, occ['count']):.2f}); "
+                  f"peak KV blocks {engine.allocator.peak_in_use} "
+                  f"of {engine.allocator.capacity}")
+            assert engine.allocator.in_use == 0, "KV blocks leaked"
+
+
+if __name__ == "__main__":
+    main()

@@ -99,9 +99,10 @@ def main():
 
             snap = metrics.snapshot()
             bs = snap["hvd_tpu_serving_batch_size"]
+            swaps = int(snap['hvd_tpu_serving_hot_swaps_total'
+                             '{plane="inference"}'])
             print(f"served {int(bs['sum'])} rows in {int(bs['count'])} "
-                  f"micro-batches; hot swaps: "
-                  f"{int(snap['hvd_tpu_serving_hot_swaps_total'])}")
+                  f"micro-batches; hot swaps: {swaps}")
 
 
 if __name__ == "__main__":

@@ -450,6 +450,57 @@ SERVING_WARMUP = _register(
          "zero-filled inputs, so no live request pays an XLA compile. "
          "Set 0 to trade first-request latency for faster startup.")
 
+# -- Generation serving (no reference equivalent — the continuous-batching
+#    decode plane, serving/generation/: paged KV cache + iteration-level
+#    scheduling for autoregressive models) ------------------------------------
+GEN_BLOCK_SIZE = _register(
+    "GEN_BLOCK_SIZE", 16, int,
+    help="Tokens per KV-cache block in the paged generation cache. "
+         "Smaller blocks track live tokens tighter (less padding waste "
+         "per sequence, at most block_size-1 slots); larger blocks mean "
+         "fewer allocator operations and block-table entries. The "
+         "compiled decode program gathers max_seq_len/block_size blocks "
+         "per sequence, so the product with HVD_TPU_GEN_NUM_BLOCKS is "
+         "the pool's token capacity.")
+GEN_NUM_BLOCKS = _register(
+    "GEN_NUM_BLOCKS", 512, int,
+    help="KV-cache blocks in the generation pool (block 0 is reserved "
+         "as the null block for padded writes). Total cache memory is "
+         "num_blocks * block_size * 2KV * layers * heads * head_dim * "
+         "dtype bytes, allocated once at engine start; sequences "
+         "allocate blocks on growth and free on retirement, and "
+         "exhaustion preempts the youngest sequence "
+         "(hvd_tpu_gen_preemptions_total) instead of wedging.")
+GEN_MAX_SEQS = _register(
+    "GEN_MAX_SEQS", 8, int,
+    help="Decode batch slots: the most sequences the generation "
+         "scheduler decodes concurrently (the compiled decode program's "
+         "static batch dimension). The iteration-level scheduler "
+         "re-forms the batch every step, so a freed slot is refilled "
+         "from the waiting line within one decode step.")
+GEN_PREFILL_CHUNK = _register(
+    "GEN_PREFILL_CHUNK", 64, int,
+    help="Prompt tokens processed per prefill call (the compiled "
+         "prefill program's static chunk width). Long prompts are "
+         "split into chunks and interleaved with decode steps, so a "
+         "prompt of any length stalls in-flight decodes for at most "
+         "one chunk per step; larger chunks prefill faster but stall "
+         "decodes longer per step.")
+GEN_QUEUE_DEPTH = _register(
+    "GEN_QUEUE_DEPTH", 64, int,
+    help="Admission control for generation: bound on submitted "
+         "sequences not yet admitted to the running batch. A request "
+         "arriving at a full queue is rejected immediately (HTTP 503), "
+         "same policy as HVD_TPU_SERVING_QUEUE_DEPTH.")
+GEN_DEADLINE_MS = _register(
+    "GEN_DEADLINE_MS", 30000.0, float,
+    help="Default per-TOKEN generation deadline in milliseconds "
+         "(callers can set a per-request value): the allowed gap to "
+         "the next emitted token, reset on every emission. A sequence "
+         "that waits longer — parked at admission or preempted and "
+         "awaiting blocks — fails with the serving plane's deadline "
+         "error (HTTP 429). 0 disables deadlines.")
+
 # -- Misc -------------------------------------------------------------------
 NUM_STREAMS = _register(
     "NUM_STREAMS", 1, int, alias="HOROVOD_NUM_NCCL_STREAMS",

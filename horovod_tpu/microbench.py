@@ -392,3 +392,195 @@ def scaling_sweep_point(batch_per_device: int = 8, image_size: int = 32,
         "images_per_sec_per_device": r.images_per_sec_per_chip,
         "platform": r.platform,
     }
+
+
+def generation_sweep(num_requests: int = 24, batch_slots: int = 8,
+                     block_size: int = 8) -> dict:
+    """Continuous batching vs static full-batch generation on a
+    mixed-length prompt workload (ROADMAP item 1's acceptance pair).
+
+    Both modes drive the *same* compiled paged prefill/decode programs
+    (``serving.generation.build_program``), so the measured gap is pure
+    scheduling + memory policy, not kernel differences:
+
+    * **static** — the classic served-systems baseline: requests form
+      batches of ``batch_slots`` in arrival order; each batch prefills,
+      reserves KV for its longest possible sequence in *every* slot,
+      and decodes until its longest request finishes — finished lanes
+      keep burning decode steps, and the next batch cannot start early.
+    * **continuous** — the :class:`GenerationEngine` end-to-end:
+      iteration-level admission into freed slots, immediate retirement,
+      paged allocate-on-growth.
+
+    Reported per mode: wall seconds, useful tokens/sec (prompt tokens
+    excluded), decode steps, and peak KV bytes (allocator high-water x
+    block bytes for continuous; the reservation high-water for static).
+    """
+    import threading
+
+    import jax
+    import jax.numpy as jnp
+
+    from .models.transformer import (PagedCache, Transformer,
+                                     TransformerConfig)
+    from .serving.generation import (GenerationEngine, block_bytes,
+                                     build_program, make_pools)
+    from .serving.generation.scheduler import DECODE_WIDTH
+    from . import metrics as _metrics
+
+    cfg = TransformerConfig(vocab_size=512, num_layers=4, d_model=128,
+                            num_heads=4, head_dim=32, max_seq_len=128,
+                            dtype=jnp.float32)
+    model = Transformer(cfg)
+    rng = np.random.RandomState(0)
+    params = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 4), jnp.int32))
+    prefill_chunk = 16
+
+    # mixed-length workload: a few long generations pinned among bursts
+    # of short ones (the shape that strands static batches), mixed
+    # prompt lengths including one past the prefill chunk
+    new_lens = [(32, 4, 4, 4, 8, 4, 16, 4)[i % 8]
+                for i in range(num_requests)]
+    prompts = [rng.randint(0, cfg.vocab_size,
+                           (4 + (i * 5) % 20,)).tolist()
+               for i in range(num_requests)]
+    total_new = sum(new_lens)
+    per_block = block_bytes(cfg, block_size)
+    program = build_program(model)
+    max_blocks = -(-cfg.max_seq_len // block_size)
+
+    # -- static full-batch baseline -----------------------------------------
+    def run_static():
+        peak_blocks = 0
+        decode_steps = 0
+        outs = {}
+        t0 = time.perf_counter()
+        for lo in range(0, num_requests, batch_slots):
+            group = list(range(lo, min(lo + batch_slots, num_requests)))
+            longest = max(len(prompts[i]) + new_lens[i] for i in group)
+            per_seq = -(-longest // block_size)
+            # static reservation: worst case for EVERY slot in the batch
+            peak_blocks = max(peak_blocks, per_seq * len(group))
+            # pool sized like the continuous engine's, so both modes
+            # share the same compiled program shapes (the reservation
+            # accounting above is what static *requires*, not what the
+            # shared pool holds)
+            k, v = make_pools(cfg, batch_slots * max_blocks + 1,
+                              block_size)
+            tables = np.zeros((batch_slots, max_blocks), np.int32)
+            for j in range(len(group)):
+                tables[j, :per_seq] = 1 + j * per_seq + np.arange(per_seq)
+            seqs = [list(prompts[i]) for i in group]
+            # prefill, one sequence at a time (the chunked program)
+            for j, i in enumerate(group):
+                done = 0
+                while done < len(prompts[i]):
+                    chunk = prompts[i][done:done + prefill_chunk]
+                    buf = np.zeros((1, prefill_chunk), np.int32)
+                    buf[0, :len(chunk)] = chunk
+                    cache = PagedCache(k, v, jnp.asarray(tables[j:j + 1]),
+                                       jnp.asarray([done], jnp.int32),
+                                       jnp.asarray([len(chunk)], jnp.int32))
+                    logits, cache = program(params, cache, jnp.asarray(buf))
+                    k, v = cache.k, cache.v
+                    done += len(chunk)
+                seqs[j].append(int(np.argmax(
+                    np.asarray(logits)[0, len(chunk) - 1])))
+            # decode to the BATCH max — finished lanes keep stepping
+            batch_max = max(new_lens[i] for i in group)
+            for _step in range(batch_max - 1):
+                tokens = np.zeros((batch_slots, DECODE_WIDTH), np.int32)
+                lengths = np.zeros((batch_slots,), np.int32)
+                live = np.zeros((batch_slots,), np.int32)
+                for j in range(len(group)):
+                    tokens[j, 0] = seqs[j][-1]
+                    lengths[j] = len(seqs[j]) - 1
+                    live[j] = 1
+                cache = PagedCache(k, v, jnp.asarray(tables),
+                                   jnp.asarray(lengths), jnp.asarray(live))
+                logits, cache = program(params, cache, jnp.asarray(tokens))
+                k, v = cache.k, cache.v
+                decode_steps += 1
+                for j in range(len(group)):
+                    seqs[j].append(int(np.argmax(np.asarray(logits)[j, 0])))
+            for j, i in enumerate(group):
+                outs[i] = seqs[j][len(prompts[i]):
+                                  len(prompts[i]) + new_lens[i]]
+        wall = time.perf_counter() - t0
+        return wall, peak_blocks, decode_steps, outs
+
+    # -- continuous batching -------------------------------------------------
+    def run_continuous():
+        snap0 = _metrics.snapshot()
+        engine = GenerationEngine(
+            model, params=params, block_size=block_size,
+            num_blocks=batch_slots * max_blocks + 1, max_seqs=batch_slots,
+            prefill_chunk=prefill_chunk, queue_depth=num_requests,
+            deadline_ms=0)
+        outs = [None] * num_requests
+        t0 = time.perf_counter()
+
+        def client(i):
+            outs[i] = engine.generate(prompts[i], max_tokens=new_lens[i],
+                                      timeout=600)
+
+        threads = [threading.Thread(target=client, args=(i,))
+                   for i in range(num_requests)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        wall = time.perf_counter() - t0
+        snap1 = _metrics.snapshot()
+        occ0 = snap0.get("hvd_tpu_gen_batch_occupancy",
+                         {"count": 0, "sum": 0})
+        occ1 = snap1["hvd_tpu_gen_batch_occupancy"]
+        steps = int(occ1["count"] - occ0["count"])
+        occupancy = (occ1["sum"] - occ0["sum"]) / max(1, steps)
+        preempt = snap1.get("hvd_tpu_gen_preemptions_total", 0) \
+            - snap0.get("hvd_tpu_gen_preemptions_total", 0)
+        peak = engine.allocator.peak_in_use
+        leaked = engine.allocator.in_use
+        engine.close()
+        assert leaked == 0, f"{leaked} KV blocks leaked"
+        return wall, peak, steps, occupancy, preempt, outs
+
+    # compile both program shapes before any clock starts
+    run_static()
+    st_wall, st_peak, st_steps, st_outs = run_static()
+    ct_wall, ct_peak, ct_steps, ct_occ, ct_preempt, ct_outs = \
+        run_continuous()
+    # same greedy tokens from both schedulers, or the comparison is moot
+    mismatch = sum(st_outs[i] != ct_outs[i] for i in range(num_requests))
+    assert mismatch == 0, f"{mismatch} sequences diverged across modes"
+
+    return {
+        "scenario": "mixed_length_generation",
+        "num_requests": num_requests,
+        "batch_slots": batch_slots,
+        "block_size": block_size,
+        "model": {"layers": cfg.num_layers, "d_model": cfg.d_model,
+                  "heads": cfg.num_heads, "head_dim": cfg.head_dim,
+                  "vocab": cfg.vocab_size, "max_seq_len": cfg.max_seq_len},
+        "total_prompt_tokens": sum(len(p) for p in prompts),
+        "total_new_tokens": total_new,
+        "static": {
+            "wall_s": round(st_wall, 3),
+            "tokens_per_s": round(total_new / st_wall, 1),
+            "decode_steps": st_steps,
+            "peak_kv_blocks": st_peak,
+            "peak_kv_bytes": st_peak * per_block,
+        },
+        "continuous": {
+            "wall_s": round(ct_wall, 3),
+            "tokens_per_s": round(total_new / ct_wall, 1),
+            "decode_steps": ct_steps,
+            "avg_occupancy": round(ct_occ, 2),
+            "preemptions": int(ct_preempt),
+            "peak_kv_blocks": ct_peak,
+            "peak_kv_bytes": ct_peak * per_block,
+        },
+        "continuous_speedup": round(st_wall / ct_wall, 2),
+        "kv_bytes_vs_static_reservation": round(ct_peak / st_peak, 3)
+        if st_peak else None,
+    }

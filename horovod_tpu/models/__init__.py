@@ -9,6 +9,7 @@ that tile onto the 128x128 MXU, and no data-dependent Python control flow.
 
 from .resnet import ResNet, ResNet18, ResNet34, ResNet50, ResNet101, ResNet152  # noqa: F401
 from .mlp import MLP  # noqa: F401
-from .transformer import Transformer, TransformerConfig  # noqa: F401
+from .transformer import (PagedCache, Transformer,  # noqa: F401
+                          TransformerConfig)
 from .vgg import VGG, VGG11, VGG13, VGG16, VGG19  # noqa: F401
 from .inception import InceptionV3  # noqa: F401

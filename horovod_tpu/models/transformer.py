@@ -13,6 +13,24 @@ build's parallelism strategies (TP/SP/PP/EP/ring attention — SURVEY.md §2.3,
 * causal attention runs through :func:`attention_fn` injection so context
   parallelism (ring attention over 'sp' via ppermute) and Pallas
   flash-attention kernels plug in without touching the model.
+
+Decode path (the serving generation plane,
+:mod:`horovod_tpu.serving.generation`): the same compact module — the
+same parameter tree, so any training checkpoint serves — also runs an
+incremental forward against a **paged KV cache** when ``__call__`` is
+given a :class:`PagedCache`. One code path covers both phases of
+autoregressive generation: a *prefill chunk* (``tokens`` is ``(B, C)``
+with ``C`` prompt tokens, of which ``live`` are real) and a *decode
+step* (``C == 1``). New K/V are scattered into fixed-size cache blocks
+through each sequence's block table, then attention gathers the whole
+table back — so live KV memory scales with live tokens, not
+``max_len × batch``. Block 0 is the **null block**: padded slots and
+dead batch lanes write there (and only there), which keeps every shape
+static across steps — the jit cache sees exactly two programs, one per
+phase. The paged read path deliberately reuses
+:func:`_default_attention` so decode logits are bit-identical to the
+full-sequence forward (``attention_fn`` injection is a training-side
+hook and is not consulted during paged decode).
 """
 
 import dataclasses
@@ -41,6 +59,33 @@ class TransformerConfig:
     remat: bool = False
 
 
+@dataclasses.dataclass(frozen=True)
+class PagedCache:
+    """The paged-KV view threaded through one incremental forward.
+
+    ``k``/``v``: ``(num_layers, num_blocks, block_size, heads, head_dim)``
+    pools (block 0 reserved as the null block). ``block_tables``:
+    ``(B, max_blocks)`` int32 — each row maps a sequence's logical block
+    index to a pool block (0-padded past its allocation). ``lengths``:
+    ``(B,)`` tokens already in each sequence's cache (the chunk starts
+    there). ``live``: ``(B,)`` how many of this chunk's ``C`` tokens are
+    real; pad tokens (and dead lanes, ``live == 0``) write to the null
+    block. All leaves are arrays, so the dataclass flattens cleanly
+    through ``jax.jit`` argument trees.
+    """
+
+    k: Any
+    v: Any
+    block_tables: Any
+    lengths: Any
+    live: Any
+
+
+jax.tree_util.register_dataclass(
+    PagedCache, data_fields=["k", "v", "block_tables", "lengths", "live"],
+    meta_fields=[])
+
+
 def _default_attention(q, k, v, mask, dtype):
     """Plain softmax attention: (B, S, H, D) inputs, causal mask applied.
     Softmax in fp32 (TPU recipe: keep reductions out of bf16)."""
@@ -57,7 +102,7 @@ class Attention(nn.Module):
     cfg: TransformerConfig
 
     @nn.compact
-    def __call__(self, x, mask):
+    def __call__(self, x, mask, layer_cache=None):
         cfg = self.cfg
         H, D = cfg.num_heads, cfg.head_dim
         wq = self.param("wq", nn.with_logical_partitioning(
@@ -76,9 +121,33 @@ class Attention(nn.Module):
         q = jnp.einsum("bse,ehd->bshd", x, wq.astype(dt))
         k = jnp.einsum("bse,ehd->bshd", x, wk.astype(dt))
         v = jnp.einsum("bse,ehd->bshd", x, wv.astype(dt))
-        attn = cfg.attention_fn or _default_attention
-        out = attn(q, k, v, mask, dt)
-        return jnp.einsum("bshd,hde->bse", out, wo.astype(dt))
+        if layer_cache is None:
+            attn = cfg.attention_fn or _default_attention
+            out = attn(q, k, v, mask, dt)
+            return jnp.einsum("bshd,hde->bse", out, wo.astype(dt))
+        # -- paged incremental path ---------------------------------------
+        # layer_cache: this layer's (num_blocks, block_size, H, D) pools
+        # plus the batch's tables/positions; see PagedCache.
+        k_slab, v_slab, block_tables, positions, live = layer_cache
+        B, C = x.shape[0], x.shape[1]
+        block_size = k_slab.shape[1]
+        # scatter the chunk's K/V through the block tables; pad tokens
+        # (and dead lanes) route to the null block 0
+        blk_idx = positions // block_size                       # (B, C)
+        offsets = positions % block_size                        # (B, C)
+        blocks = jnp.take_along_axis(
+            block_tables, blk_idx.astype(jnp.int32), axis=1)    # (B, C)
+        valid = jnp.arange(C)[None, :] < live[:, None]
+        blocks = jnp.where(valid, blocks, 0)
+        k_slab = k_slab.at[blocks, offsets].set(k)
+        v_slab = v_slab.at[blocks, offsets].set(v)
+        # gather every table slot back as one contiguous (B, T, H, D)
+        # view — T = max_blocks * block_size, position t lives at index t
+        kc = k_slab[block_tables].reshape(B, -1, H, D)
+        vc = v_slab[block_tables].reshape(B, -1, H, D)
+        out = _default_attention(q, kc, vc, mask, dt)
+        return (jnp.einsum("bshd,hde->bse", out, wo.astype(dt)),
+                (k_slab, v_slab))
 
 
 class MlpBlock(nn.Module):
@@ -104,20 +173,26 @@ class DecoderLayer(nn.Module):
     cfg: TransformerConfig
 
     @nn.compact
-    def __call__(self, x, mask):
+    def __call__(self, x, mask, layer_cache=None):
         cfg = self.cfg
         ln = lambda name: nn.LayerNorm(  # noqa: E731
             dtype=cfg.dtype, param_dtype=jnp.float32, name=name)
-        x = x + Attention(cfg, name="attn")(ln("ln1")(x), mask)
+        if layer_cache is None:
+            x = x + Attention(cfg, name="attn")(ln("ln1")(x), mask)
+            x = x + MlpBlock(cfg, name="mlp")(ln("ln2")(x))
+            return x
+        attn_out, kv = Attention(cfg, name="attn")(
+            ln("ln1")(x), mask, layer_cache=layer_cache)
+        x = x + attn_out
         x = x + MlpBlock(cfg, name="mlp")(ln("ln2")(x))
-        return x
+        return x, kv
 
 
 class Transformer(nn.Module):
     cfg: TransformerConfig
 
     @nn.compact
-    def __call__(self, tokens):
+    def __call__(self, tokens, cache=None):
         cfg = self.cfg
         B, S = tokens.shape
         emb = self.param("embedding", nn.with_logical_partitioning(
@@ -126,15 +201,47 @@ class Transformer(nn.Module):
         pos = self.param("pos_embedding", nn.with_logical_partitioning(
             nn.initializers.normal(0.02), (None, "embed")),
             (cfg.max_seq_len, cfg.d_model), jnp.float32)
-        x = emb.astype(cfg.dtype)[tokens] + pos.astype(cfg.dtype)[None, :S]
-        mask = jnp.tril(jnp.ones((S, S), jnp.bool_))[None, None]
+        if cache is None:
+            x = emb.astype(cfg.dtype)[tokens] \
+                + pos.astype(cfg.dtype)[None, :S]
+            mask = jnp.tril(jnp.ones((S, S), jnp.bool_))[None, None]
+            layer_caches = [None] * cfg.num_layers
+        else:
+            # incremental: S == chunk length C; absolute positions come
+            # from each sequence's cache length (clipped only to keep
+            # the pad-token gather in bounds — live tokens are validated
+            # host-side against max_seq_len before submission)
+            positions = cache.lengths[:, None] + jnp.arange(S)[None, :]
+            safe_pos = jnp.clip(positions, 0, cfg.max_seq_len - 1)
+            x = emb.astype(cfg.dtype)[tokens] \
+                + pos.astype(cfg.dtype)[safe_pos]
+            # gathered cache slot t holds absolute position t; a chunk
+            # query at absolute position p attends to every t <= p
+            t_max = cache.block_tables.shape[1] * cache.k.shape[2]
+            mask = (jnp.arange(t_max)[None, None, None, :]
+                    <= positions[:, None, :, None])
+            layer_caches = [
+                (cache.k[i], cache.v[i], cache.block_tables, positions,
+                 cache.live) for i in range(cfg.num_layers)]
+        k_pool, v_pool = (None, None) if cache is None else (cache.k,
+                                                            cache.v)
         layer_cls = DecoderLayer
-        if cfg.remat:
+        if cfg.remat and cache is None:
             layer_cls = nn.remat(DecoderLayer, static_argnums=())
         for i in range(cfg.num_layers):
-            x = layer_cls(cfg, name=f"layer_{i}")(x, mask)
+            out = layer_cls(cfg, name=f"layer_{i}")(x, mask,
+                                                    layer_caches[i])
+            if cache is None:
+                x = out
+            else:
+                x, (k_i, v_i) = out
+                k_pool = k_pool.at[i].set(k_i)
+                v_pool = v_pool.at[i].set(v_i)
         x = nn.LayerNorm(dtype=cfg.dtype, param_dtype=jnp.float32,
                          name="ln_f")(x)
         # logits in fp32, weight-tied to the embedding
-        return jnp.einsum("bse,ve->bsv", x.astype(jnp.float32),
-                          emb.astype(jnp.float32))
+        logits = jnp.einsum("bse,ve->bsv", x.astype(jnp.float32),
+                            emb.astype(jnp.float32))
+        if cache is None:
+            return logits
+        return logits, dataclasses.replace(cache, k=k_pool, v=v_pool)

@@ -13,8 +13,14 @@ training side already trusts:
   newer committed steps with an atomic swap (zero downtime, in-flight
   requests never split across checkpoints);
 * :mod:`.server` — :class:`InferenceServer`: threaded stdlib HTTP
-  front-end (``POST /v1/infer``, ``GET /healthz``) where admission
-  control degrades overload to fast 429/503 backpressure.
+  front-end (``POST /v1/infer``, ``POST /v1/generate``,
+  ``GET /healthz``) where admission control degrades overload to fast
+  429/503 backpressure;
+* :mod:`.generation` — the continuous-batching decode plane:
+  :class:`GenerationEngine` serves autoregressive generation from a
+  paged KV cache with iteration-level scheduling, reusing the same
+  checkpoint restore + hot-reload lifecycle
+  (:class:`~horovod_tpu.serving.engine.ParamsLifecycle`).
 
 Quick start::
 
@@ -33,5 +39,7 @@ chaos-drill recipes.
 from .batcher import (BucketedForward, DeadlineExceededError,  # noqa: F401
                       MicroBatcher, QueueFullError, RejectedError,
                       bucket_for, parse_buckets)
-from .engine import InferenceEngine, ReloadCrashed, wait_for_step  # noqa: F401
+from .engine import (InferenceEngine, ParamsLifecycle,  # noqa: F401
+                     ReloadCrashed, wait_for_step)
 from .server import InferenceServer                               # noqa: F401
+from .generation import GenerationEngine                          # noqa: F401

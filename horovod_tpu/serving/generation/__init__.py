@@ -1,0 +1,40 @@
+"""Continuous-batching generation: the decode-native serving plane.
+
+The PR 5 serving stack batches *requests* into fixed-shape forwards —
+right for classification/embedding, wrong for autoregressive decode,
+where sequences finish at different lengths and memory wants to track
+live tokens. This package is the decode-native plane layered on the
+same admission machinery:
+
+* :mod:`.kv_cache` — paged KV cache: fixed-size block pools
+  (``HVD_TPU_GEN_BLOCK_SIZE`` x ``HVD_TPU_GEN_NUM_BLOCKS``), a strict
+  block allocator, and the one jitted incremental forward both phases
+  share;
+* :mod:`.scheduler` — :class:`ContinuousBatcher`: iteration-level
+  scheduling (admit / one prefill chunk / one decode step, every step),
+  immediate retirement on EOS or ``max_tokens``, preempt-and-requeue on
+  block exhaustion, per-token deadlines;
+* :mod:`.engine` — :class:`GenerationEngine`: the scheduler glued to
+  the shared checkpoint restore + hot-reload lifecycle
+  (:class:`~horovod_tpu.serving.engine.ParamsLifecycle`).
+
+Quick start::
+
+    from horovod_tpu.models import Transformer, TransformerConfig
+    import horovod_tpu.serving as serving
+
+    engine = serving.GenerationEngine(
+        Transformer(cfg), checkpoint_dir="/ckpts/run1", eos_id=2)
+    with serving.InferenceServer(engine=None, gen_engine=engine,
+                                 port=8500):
+        ...   # POST /v1/generate {"prompt": [...], "max_tokens": 32}
+    for tok in engine.stream([1, 5, 9], max_tokens=64):
+        ...   # in-process streaming
+
+See docs/inference.md for architecture, knobs, metrics, and drills.
+"""
+
+from .engine import GenerationEngine                        # noqa: F401
+from .kv_cache import (BlockAllocator, BlocksExhaustedError,  # noqa: F401
+                       block_bytes, build_program, make_pools)
+from .scheduler import ContinuousBatcher, GenSequence       # noqa: F401

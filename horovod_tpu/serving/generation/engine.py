@@ -1,0 +1,150 @@
+"""The generation engine: params lifecycle + the continuous batcher.
+
+:class:`GenerationEngine` is the decode-native sibling of
+:class:`~horovod_tpu.serving.engine.InferenceEngine`, glued from the
+same parts:
+
+* the shared :class:`~horovod_tpu.serving.engine.ParamsLifecycle` —
+  checkpoint restore onto the serving mesh plus zero-downtime
+  hot-reload (the ``serving.reload`` fault site and
+  ``hvd_tpu_serving_hot_swaps_total`` apply unchanged). The scheduler
+  snapshots the params reference once per device call, so a hot-swap
+  lands *between* prefill/decode steps, never inside one; a sequence
+  spanning a swap continues greedily under the new params (documented
+  behavior — decode caches are value-compatible, not step-pinned);
+* a :class:`~horovod_tpu.serving.generation.scheduler.ContinuousBatcher`
+  over a paged KV cache
+  (:mod:`~horovod_tpu.serving.generation.kv_cache`), sized by
+  ``HVD_TPU_GEN_NUM_BLOCKS`` x ``HVD_TPU_GEN_BLOCK_SIZE``.
+
+The model must be a
+:class:`~horovod_tpu.models.transformer.Transformer` (or expose the
+same ``apply(params, tokens, cache=PagedCache)`` contract and a ``cfg``
+with ``num_layers/num_heads/head_dim/max_seq_len/dtype``).
+"""
+
+from typing import Any, List, Optional, Sequence
+
+from ... import config as _config
+from ..engine import ParamsLifecycle
+from .kv_cache import BlockAllocator, build_program, make_pools
+from .scheduler import ContinuousBatcher, GenSequence
+
+
+class GenerationEngine:
+    """Serve autoregressive generation from ``model`` with continuous
+    batching, paged KV cache, and checkpoint hot-reload.
+
+    Args:
+      model: the decode-capable model (see module docstring).
+      checkpoint_dir / params / sharding / step / reload_poll_seconds:
+        the :class:`ParamsLifecycle` contract — exactly one of
+        ``params`` and ``checkpoint_dir``.
+      eos_id: default EOS token id for submitted sequences (per-request
+        override wins; None runs every sequence to its ``max_tokens``).
+      on_step: optional scheduler observability hook
+        (``on_step(phase, [seq_id, ...])``).
+
+    Knob-backed arguments (``block_size``, ``num_blocks``, ``max_seqs``,
+    ``prefill_chunk``, ``queue_depth``, ``deadline_ms``) default to
+    their registered generation knobs (docs/configuration.md).
+    """
+
+    def __init__(self, model, checkpoint_dir: Optional[str] = None,
+                 params: Any = None, sharding=None,
+                 step: Optional[int] = None,
+                 eos_id: Optional[int] = None,
+                 block_size: Optional[int] = None,
+                 num_blocks: Optional[int] = None,
+                 max_seqs: Optional[int] = None,
+                 prefill_chunk: Optional[int] = None,
+                 queue_depth: Optional[int] = None,
+                 deadline_ms: Optional[float] = None,
+                 reload_poll_seconds: Optional[float] = None,
+                 on_step=None):
+        cfg = _config.live_config()
+        block_size = int(cfg.get(_config.GEN_BLOCK_SIZE)
+                         if block_size is None else block_size)
+        num_blocks = int(cfg.get(_config.GEN_NUM_BLOCKS)
+                         if num_blocks is None else num_blocks)
+        self.model = model
+        self._lifecycle = ParamsLifecycle(
+            checkpoint_dir=checkpoint_dir, params=params, sharding=sharding,
+            step=step, reload_poll_seconds=reload_poll_seconds,
+            plane="generation")
+        self.allocator = BlockAllocator(num_blocks, block_size)
+        pools = make_pools(model.cfg, num_blocks, block_size)
+        self.batcher = ContinuousBatcher(
+            build_program(model),
+            lambda: self._lifecycle.snapshot()[0],
+            pools, self.allocator,
+            max_seq_len=model.cfg.max_seq_len, max_seqs=max_seqs,
+            prefill_chunk=prefill_chunk, queue_depth=queue_depth,
+            deadline_ms=deadline_ms, eos_id=eos_id,
+            vocab_size=model.cfg.vocab_size, on_step=on_step)
+        self._lifecycle.start_poller()    # last: nothing can fail past here
+
+    # -- generation ----------------------------------------------------------
+
+    def submit(self, prompt: Sequence[int], max_tokens: int = 16,
+               eos_id: Optional[int] = None,
+               deadline_ms: Optional[float] = None) -> GenSequence:
+        """Admit one request; returns the sequence handle for
+        :meth:`result` / :meth:`stream`. Raises ``QueueFullError``
+        (503) / ``DeadlineExceededError`` (429) / ``ValueError``
+        (400) with the serving plane's admission semantics."""
+        return self.batcher.submit(prompt, max_tokens=max_tokens,
+                                   eos_id=eos_id, deadline_ms=deadline_ms)
+
+    def result(self, seq: GenSequence,
+               timeout: Optional[float] = None) -> List[int]:
+        return self.batcher.result(seq, timeout=timeout)
+
+    def stream(self, prompt: Sequence[int], max_tokens: int = 16,
+               eos_id: Optional[int] = None,
+               deadline_ms: Optional[float] = None,
+               timeout: Optional[float] = None):
+        """submit + yield tokens as the scheduler emits them."""
+        seq = self.submit(prompt, max_tokens=max_tokens, eos_id=eos_id,
+                          deadline_ms=deadline_ms)
+        return self.batcher.stream(seq, timeout=timeout)
+
+    def generate(self, prompt: Sequence[int], max_tokens: int = 16,
+                 eos_id: Optional[int] = None,
+                 deadline_ms: Optional[float] = None,
+                 timeout: Optional[float] = None) -> List[int]:
+        """Blocking generation: prompt tokens in, generated tokens out."""
+        return self.batcher.generate(prompt, max_tokens=max_tokens,
+                                     eos_id=eos_id, deadline_ms=deadline_ms,
+                                     timeout=timeout)
+
+    # -- lifecycle -----------------------------------------------------------
+
+    @property
+    def checkpoint_dir(self):
+        return self._lifecycle.checkpoint_dir
+
+    @property
+    def step(self) -> int:
+        return self._lifecycle.step
+
+    @property
+    def params(self):
+        return self._lifecycle.params
+
+    def reload(self, step: Optional[int] = None) -> bool:
+        """Force a checkpoint hot-reload now (see
+        :meth:`ParamsLifecycle.reload`)."""
+        return self._lifecycle.reload(step=step)
+
+    def close(self, timeout: float = 10.0) -> None:
+        """Idempotent: stop the reload poller and the scheduler thread
+        (queued/running sequences fail; all KV blocks return)."""
+        self._lifecycle.close(timeout=timeout)
+        self.batcher.stop(timeout=timeout)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()

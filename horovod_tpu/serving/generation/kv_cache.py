@@ -1,0 +1,161 @@
+"""Paged KV cache: fixed-size block pools + the block allocator.
+
+vLLM's PagedAttention observation, applied to this stack: a dense KV
+cache reserves ``max_len x batch`` per layer, but at any instant only
+the *live* tokens matter. So the cache is a pool of fixed-size blocks
+(``HVD_TPU_GEN_BLOCK_SIZE`` tokens each, ``HVD_TPU_GEN_NUM_BLOCKS`` of
+them) and every sequence owns an ordered *block table* mapping its
+logical block index to a pool block. Blocks are allocated on growth
+(one at a time as decode crosses a block boundary, a run at once for a
+prefill chunk) and freed the moment a sequence finishes or is
+preempted — live KV memory tracks live tokens.
+
+**Block 0 is the null block.** It is never handed out: the model routes
+every padded-token and dead-lane write there
+(:class:`horovod_tpu.models.transformer.PagedCache`), which is what
+lets the compiled prefill/decode programs keep fully static shapes
+while batch composition changes every step.
+
+The allocator is strict by design: allocation is all-or-nothing
+(:class:`BlocksExhaustedError` is the scheduler's preemption trigger,
+never a partial grant) and :meth:`BlockAllocator.free` rejects
+double-frees and foreign ids — a leak or a tangle fails the test that
+caused it, instead of surfacing as silent cache corruption under load.
+``hvd_tpu_gen_kv_blocks_in_use`` tracks the live block count;
+:attr:`BlockAllocator.peak_in_use` is the high-water mark the
+microbench compares against a dense reservation.
+"""
+
+import functools
+import math
+from typing import List
+
+from ... import _locks
+from ... import metrics as _metrics
+
+_M_BLOCKS = _metrics.gauge(
+    "hvd_tpu_gen_kv_blocks_in_use",
+    "KV-cache blocks currently allocated to live generation sequences "
+    "(the null block excluded). Live KV memory is this times the "
+    "per-block byte size; pinning near HVD_TPU_GEN_NUM_BLOCKS means "
+    "admission is block-bound and preemptions are imminent.")
+
+
+class BlocksExhaustedError(RuntimeError):
+    """Not enough free KV blocks for an allocation. Internal to the
+    generation plane: the scheduler answers it by preempting the
+    youngest running sequence, never by wedging."""
+
+
+class BlockAllocator:
+    """Free-list allocator over the KV block pool (block 0 reserved)."""
+
+    def __init__(self, num_blocks: int, block_size: int):
+        if num_blocks < 2:
+            raise ValueError(
+                f"HVD_TPU_GEN_NUM_BLOCKS={num_blocks}: need at least 2 "
+                f"(block 0 is the reserved null block)")
+        if block_size < 1:
+            raise ValueError(
+                f"HVD_TPU_GEN_BLOCK_SIZE={block_size}: must be >= 1")
+        self.num_blocks = int(num_blocks)
+        self.block_size = int(block_size)
+        #: usable blocks (block 0 excluded)
+        self.capacity = self.num_blocks - 1
+        self._lock = _locks.lock("serving.generation.BlockAllocator._lock")
+        # pop() hands out ascending ids — deterministic schedules make
+        # the chaos drills replayable
+        self._free_list = list(range(self.num_blocks - 1, 0, -1))
+        self._free_set = set(self._free_list)
+        self.peak_in_use = 0
+
+    def blocks_for(self, tokens: int) -> int:
+        """Blocks needed to hold ``tokens`` cache slots."""
+        return max(1, math.ceil(tokens / self.block_size))
+
+    @property
+    def free_blocks(self) -> int:
+        with self._lock:
+            return len(self._free_list)
+
+    @property
+    def in_use(self) -> int:
+        with self._lock:
+            return self.capacity - len(self._free_list)
+
+    def allocate(self, n: int) -> List[int]:
+        """Hand out ``n`` blocks, all-or-nothing. Raises
+        :class:`BlocksExhaustedError` when fewer than ``n`` are free."""
+        if n <= 0:
+            return []
+        with self._lock:
+            if n > len(self._free_list):
+                raise BlocksExhaustedError(
+                    f"need {n} KV blocks, {len(self._free_list)} free "
+                    f"(of {self.capacity} usable)")
+            out = [self._free_list.pop() for _ in range(n)]
+            self._free_set.difference_update(out)
+            in_use = self.capacity - len(self._free_list)
+            if in_use > self.peak_in_use:
+                self.peak_in_use = in_use
+        _M_BLOCKS.set(in_use)
+        return out
+
+    def free(self, blocks: List[int]) -> None:
+        """Return blocks to the pool. A double-free, the null block, or
+        an id outside the pool raises — accounting bugs must fail the
+        caller, not corrupt a stranger's cache."""
+        with self._lock:
+            for b in blocks:
+                if not 1 <= b < self.num_blocks:
+                    raise ValueError(
+                        f"free of invalid KV block id {b} (pool is "
+                        f"1..{self.num_blocks - 1})")
+                if b in self._free_set:
+                    raise ValueError(f"double free of KV block {b}")
+            for b in blocks:
+                self._free_list.append(b)
+                self._free_set.add(b)
+            in_use = self.capacity - len(self._free_list)
+        _M_BLOCKS.set(in_use)
+
+
+def make_pools(model_cfg, num_blocks: int, block_size: int):
+    """Zeroed K/V pools for ``model_cfg`` (a
+    :class:`~horovod_tpu.models.transformer.TransformerConfig`):
+    ``(num_layers, num_blocks, block_size, heads, head_dim)`` each, in
+    the model's activation dtype."""
+    import jax.numpy as jnp
+    shape = (model_cfg.num_layers, num_blocks, block_size,
+             model_cfg.num_heads, model_cfg.head_dim)
+    return jnp.zeros(shape, model_cfg.dtype), jnp.zeros(shape,
+                                                        model_cfg.dtype)
+
+
+def block_bytes(model_cfg, block_size: int) -> int:
+    """Bytes of KV cache one block holds (K and V, all layers)."""
+    import jax.numpy as jnp
+    itemsize = jnp.dtype(model_cfg.dtype).itemsize
+    return (2 * model_cfg.num_layers * block_size * model_cfg.num_heads
+            * model_cfg.head_dim * itemsize)
+
+
+@functools.lru_cache(maxsize=8)
+def build_program(model):
+    """The one jitted incremental forward both phases share.
+
+    ``(params, PagedCache, tokens) -> (logits, PagedCache)``; the cache
+    argument is donated so XLA updates the pools in place. Called with
+    ``tokens`` of shape ``(1, prefill_chunk)`` it is the prefill
+    program; with ``(max_seqs, DECODE_WIDTH)`` it is the decode
+    program — two compilations of one function, and the only two the
+    jit cache ever sees (every other shape is static). Memoized on the
+    model (flax modules hash by configuration), so engine restarts and
+    tests don't recompile identical programs.
+    """
+    import jax
+
+    def _paged_forward(params, cache, tokens):
+        return model.apply(params, tokens, cache=cache)
+
+    return jax.jit(_paged_forward, donate_argnums=(1,))

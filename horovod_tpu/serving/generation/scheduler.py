@@ -1,0 +1,631 @@
+"""Iteration-level scheduling: the continuous-batching decode loop.
+
+The PR 5 micro-batcher forms a batch once and rides it to completion —
+right for fixed-shape forwards, wrong for autoregressive decode, where
+sequences finish at different lengths and a static batch strands both
+throughput (dead lanes decode padding) and memory (max-length KV
+reservations). :class:`ContinuousBatcher` is the Orca-style answer: the
+running batch is **re-formed every decode step**.
+
+Each scheduler iteration does three things, in order:
+
+1. **admit** — move waiting sequences into the running set while batch
+   slots (``HVD_TPU_GEN_MAX_SEQS``) and KV blocks are free, FIFO, shed
+   on expired deadlines;
+2. **prefill one chunk** — the oldest prefilling sequence advances by at
+   most ``HVD_TPU_GEN_PREFILL_CHUNK`` prompt tokens, so a long prompt is
+   chunked and in-flight decodes stall for at most one step;
+3. **decode one step** — every decoding sequence contributes its last
+   token to one fixed-shape batch; finished sequences (EOS /
+   ``max_tokens``) retire *immediately*, freeing their slot and blocks
+   for the next iteration's admissions.
+
+When growth hits block exhaustion the scheduler **preempts** the
+youngest block-holding sequence instead of deadlocking: its blocks are
+freed and it requeues at the *front* of the waiting line in recompute
+mode (prompt + tokens generated so far re-prefill on readmission;
+greedy decode makes the continuation deterministic). Admission bounds
+(a sequence that could never fit is rejected at submit) make the loop
+preemption-safe: the oldest sequence can always grow.
+
+Deadlines extend the PR 5 semantics **per token**: the budget
+(``HVD_TPU_GEN_DEADLINE_MS`` or the request's ``deadline_ms``) is the
+allowed gap to the *next* token and resets on every emission, so a
+sequence parked in the waiting line — at admission or after a
+preemption — times out with the same
+:class:`~horovod_tpu.serving.batcher.DeadlineExceededError` (HTTP 429)
+a stale inference request gets, while a healthy decode never expires
+mid-stream. The bounded submit queue (``HVD_TPU_GEN_QUEUE_DEPTH``)
+rejects overload with :class:`~horovod_tpu.serving.batcher.QueueFullError`
+(HTTP 503), unchanged.
+
+Fault sites: ``serving.prefill`` (each prefill chunk — an ``error``
+fails only that sequence), ``serving.decode`` (each decode step — an
+``error`` fails only the sequences in that step's batch; waiting
+sequences are untouched and serve next), ``serving.evict`` (each
+preemption — an ``error`` fails the evicted sequence instead of
+requeueing it). See docs/robustness.md.
+"""
+
+import itertools
+import queue
+import threading
+import time
+from typing import Callable, List, Optional, Sequence
+
+import numpy as np
+
+from ... import _locks
+from ... import config as _config
+from ... import faults as _faults
+from ... import metrics as _metrics
+from ..batcher import DeadlineExceededError, QueueFullError
+from .kv_cache import BlockAllocator, BlocksExhaustedError
+
+_M_TOKENS = _metrics.counter(
+    "hvd_tpu_gen_tokens_total",
+    "Generation tokens processed by phase: 'prefill' counts prompt "
+    "tokens written into the paged KV cache (recomputed tokens after a "
+    "preemption count again — they are real work), 'decode' counts "
+    "generated tokens emitted to callers.",
+    labels=("phase",))
+_M_RUNNING = _metrics.gauge(
+    "hvd_tpu_gen_running_seqs",
+    "Sequences currently in the running set (prefilling or decoding). "
+    "Pinned at HVD_TPU_GEN_MAX_SEQS with a deep waiting line means the "
+    "slot count, not KV blocks, bounds throughput.")
+_M_WAITING = _metrics.gauge(
+    "hvd_tpu_gen_waiting_seqs",
+    "Sequences admitted to the bounded queue but not yet running "
+    "(including preempted sequences awaiting re-prefill).")
+_M_PREEMPTIONS = _metrics.counter(
+    "hvd_tpu_gen_preemptions_total",
+    "Sequences preempted on KV-block exhaustion: blocks freed, sequence "
+    "requeued at the front of the waiting line for recompute. A steady "
+    "nonzero rate means HVD_TPU_GEN_NUM_BLOCKS is undersized for the "
+    "offered length mix.")
+_M_OCCUPANCY = _metrics.histogram(
+    "hvd_tpu_gen_batch_occupancy",
+    "Live sequences per decode step (the re-formed batch, not the "
+    "padded width). Mass well below HVD_TPU_GEN_MAX_SEQS under load "
+    "means admission is starved — usually by KV blocks.",
+    buckets=(1, 2, 4, 8, 16, 32, 64))
+
+_FP_PREFILL = _faults.FaultPoint("serving.prefill")
+_FP_DECODE = _faults.FaultPoint("serving.decode")
+_FP_EVICT = _faults.FaultPoint("serving.evict")
+
+#: chunk width of the decode program: one live token plus one pad
+#: column. Width 1 would trip XLA's matrix-vector specializations,
+#: whose different reduction order breaks the decode-equals-full-forward
+#: bit-identity contract (tests pin it); width 2 stays in the same
+#: matmul regime as prefill at negligible cost.
+DECODE_WIDTH = 2
+
+_DONE = object()
+_STOP = object()
+
+
+class GenSequence:
+    """One generation request, submission to retirement. Also the
+    caller's handle: :meth:`ContinuousBatcher.result` /
+    :meth:`ContinuousBatcher.stream` consume it."""
+
+    __slots__ = ("id", "prompt", "max_tokens", "eos_id", "deadline_s",
+                 "deadline", "generated", "blocks", "prefill_tokens",
+                 "prefilled", "cache_len", "next_input", "resume_decode",
+                 "state", "error", "stream_q", "done_event", "arrived_at")
+
+    def __init__(self, seq_id: int, prompt: List[int], max_tokens: int,
+                 eos_id: Optional[int], deadline_s: float):
+        self.id = seq_id
+        self.prompt = list(prompt)
+        self.max_tokens = int(max_tokens)
+        self.eos_id = eos_id
+        self.deadline_s = deadline_s
+        self.deadline = (time.monotonic() + deadline_s
+                         if deadline_s > 0 else float("inf"))
+        self.generated: List[int] = []
+        self.blocks: List[int] = []
+        #: tokens whose K/V must be in the cache before decoding resumes
+        #: (the prompt; after a preemption, prompt + regenerated history)
+        self.prefill_tokens: List[int] = list(prompt)
+        self.prefilled = 0
+        #: tokens actually written to the cache so far
+        self.cache_len = 0
+        #: the next decode step's input token (the newest generated one)
+        self.next_input: Optional[int] = None
+        #: True when re-prefilling after a preemption: the final chunk's
+        #: logits predict a token that was already emitted — skip it
+        self.resume_decode = False
+        self.state = "waiting"      # waiting | prefill | decode | done
+        self.error: Optional[BaseException] = None
+        self.stream_q: "queue.Queue" = queue.Queue()
+        self.done_event = threading.Event()
+        self.arrived_at = time.monotonic()
+
+
+class ContinuousBatcher:
+    """The generation scheduler thread plus its submission surface.
+
+    Args:
+      program: the jitted paged forward from
+        :func:`~horovod_tpu.serving.generation.kv_cache.build_program`.
+      params_fn: zero-arg callable returning the params to use for the
+        next device call — the engine passes its hot-reload snapshot, so
+        a checkpoint swap lands between steps, never inside one.
+      pools: the ``(k, v)`` pools from :func:`make_pools`.
+      allocator: the :class:`BlockAllocator` over the same pool.
+      max_seq_len: hard cap on ``len(prompt) + max_tokens`` (the model's
+        position table bounds it).
+      eos_id: default EOS token id (per-request override wins; None
+        means sequences run to ``max_tokens``).
+      on_step: optional test/observability hook, called after every
+        scheduler phase as ``on_step(phase, [seq_id, ...])`` with phase
+        ``'prefill'`` or ``'decode'``.
+
+    Knob-backed arguments (``max_seqs``, ``prefill_chunk``,
+    ``queue_depth``, ``deadline_ms``) default to their registered
+    generation knobs (docs/configuration.md).
+    """
+
+    def __init__(self, program: Callable, params_fn: Callable, pools,
+                 allocator: BlockAllocator, max_seq_len: int,
+                 max_seqs: Optional[int] = None,
+                 prefill_chunk: Optional[int] = None,
+                 queue_depth: Optional[int] = None,
+                 deadline_ms: Optional[float] = None,
+                 eos_id: Optional[int] = None,
+                 vocab_size: Optional[int] = None,
+                 on_step: Optional[Callable] = None):
+        cfg = _config.live_config()
+        self._program = program
+        self._params_fn = params_fn
+        self._k, self._v = pools
+        #: shape/dtype for rebuilding the pools after a genuine device
+        #: failure: the program donates them, so a call that dies mid-
+        #: execution leaves self._k/_v pointing at deleted buffers
+        self._pool_shape = tuple(self._k.shape)
+        self._pool_dtype = self._k.dtype
+        self._alloc = allocator
+        self.max_seq_len = int(max_seq_len)
+        self.max_seqs = int(cfg.get(_config.GEN_MAX_SEQS)
+                            if max_seqs is None else max_seqs)
+        self.prefill_chunk = int(cfg.get(_config.GEN_PREFILL_CHUNK)
+                                 if prefill_chunk is None else prefill_chunk)
+        depth = int(cfg.get(_config.GEN_QUEUE_DEPTH)
+                    if queue_depth is None else queue_depth)
+        self.default_deadline_s = float(
+            cfg.get(_config.GEN_DEADLINE_MS)
+            if deadline_ms is None else deadline_ms) / 1e3
+        self.eos_id = eos_id
+        self.vocab_size = vocab_size
+        self.on_step = on_step
+        #: table width: every sequence's block table is padded to the
+        #: worst-case block count, so the compiled shapes never move
+        self.max_blocks = allocator.blocks_for(self.max_seq_len)
+        self._ids = itertools.count()
+        self._q: "queue.Queue" = queue.Queue(maxsize=max(1, depth))
+        # scheduler-thread-private state (never touched off-thread):
+        self._waiting: List[GenSequence] = []
+        self._running: List[GenSequence] = []
+        self._lock = _locks.lock(
+            "serving.generation.ContinuousBatcher._lock")
+        self._thread: Optional[threading.Thread] = None
+        self._stopped = False
+
+    # -- submission surface --------------------------------------------------
+
+    def submit(self, prompt: Sequence[int], max_tokens: int = 16,
+               eos_id: Optional[int] = None,
+               deadline_ms: Optional[float] = None) -> GenSequence:
+        """Admit one generation request. Raises
+        :class:`~horovod_tpu.serving.batcher.QueueFullError` on a full
+        queue (HTTP 503), ``ValueError`` for a request that could never
+        be served (empty prompt, non-positive ``max_tokens``, a total
+        length beyond ``max_seq_len`` or beyond the whole block pool)."""
+        prompt = [int(t) for t in prompt]
+        if not prompt:
+            raise ValueError("prompt needs at least one token")
+        if self.vocab_size is not None and any(
+                t < 0 or t >= self.vocab_size for t in prompt):
+            # reject HERE: inside the compiled gather an out-of-range id
+            # silently clamps to a wrong-but-plausible embedding
+            raise ValueError(
+                f"prompt token out of range for vocab_size="
+                f"{self.vocab_size}")
+        if max_tokens < 1:
+            raise ValueError(f"max_tokens={max_tokens}: must be >= 1")
+        total = len(prompt) + int(max_tokens)
+        if total > self.max_seq_len:
+            raise ValueError(
+                f"len(prompt) + max_tokens = {total} exceeds "
+                f"max_seq_len={self.max_seq_len}")
+        if self._alloc.blocks_for(total) > self._alloc.capacity:
+            raise ValueError(
+                f"request needs {self._alloc.blocks_for(total)} KV "
+                f"blocks, more than the whole pool "
+                f"({self._alloc.capacity} usable); raise "
+                f"HVD_TPU_GEN_NUM_BLOCKS or shorten the request")
+        ddl_s = (self.default_deadline_s if deadline_ms is None
+                 else float(deadline_ms) / 1e3)
+        if deadline_ms is not None and ddl_s < 0:
+            # same admission rule as the micro-batcher: an explicitly
+            # negative budget is already spent — shed it now
+            raise DeadlineExceededError(
+                f"request deadline_ms={deadline_ms} is negative: "
+                f"budget already spent before admission")
+        seq = GenSequence(next(self._ids), prompt, max_tokens,
+                          self.eos_id if eos_id is None else eos_id,
+                          ddl_s)
+        self._ensure_thread()
+        try:
+            self._q.put_nowait(seq)
+        except queue.Full:
+            raise QueueFullError(
+                f"generation queue at capacity ({self._q.maxsize}); "
+                f"back off and retry") from None
+        # the scheduler loop owns the waiting gauge: publishing
+        # q.qsize() + len(_waiting) from this thread would race its
+        # _publish_gauges and read scheduler-private state off-thread
+        if self._stopped:
+            # stop() raced this submit past its drain
+            self._drain_failed(RuntimeError("generation scheduler stopped"))
+        return seq
+
+    def result(self, seq: GenSequence,
+               timeout: Optional[float] = None) -> List[int]:
+        """Block until ``seq`` retires; return its generated tokens or
+        raise its error. Composable with :meth:`stream` — this waits on
+        the retirement event, not the token queue."""
+        if not seq.done_event.wait(timeout):
+            raise TimeoutError("generation result not ready in time")
+        if seq.error is not None:
+            raise seq.error
+        return list(seq.generated)
+
+    def stream(self, seq: GenSequence, timeout: Optional[float] = None):
+        """Yield ``seq``'s tokens as the scheduler emits them; raises
+        the sequence's error at the point of failure. ``timeout`` bounds
+        the wait for each *next* token."""
+        while True:
+            try:
+                tok = seq.stream_q.get(timeout=timeout)
+            except queue.Empty:
+                raise TimeoutError(
+                    "next generation token not ready in time") from None
+            if tok is _DONE:
+                if seq.error is not None:
+                    raise seq.error
+                return
+            yield tok
+
+    def generate(self, prompt: Sequence[int], max_tokens: int = 16,
+                 eos_id: Optional[int] = None,
+                 deadline_ms: Optional[float] = None,
+                 timeout: Optional[float] = None) -> List[int]:
+        """submit + result in one call (the HTTP route's path)."""
+        return self.result(self.submit(prompt, max_tokens, eos_id,
+                                       deadline_ms), timeout)
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def _ensure_thread(self) -> None:
+        with self._lock:
+            if self._stopped:
+                raise RuntimeError("ContinuousBatcher is stopped")
+            if self._thread is None or not self._thread.is_alive():
+                self._thread = threading.Thread(
+                    target=self._loop, name="hvd-tpu-gen-scheduler",
+                    daemon=True)
+                self._thread.start()
+
+    def stop(self, timeout: float = 10.0) -> None:
+        """Idempotent: stop the scheduler thread; queued and running
+        sequences are failed and every KV block returns to the pool."""
+        with self._lock:
+            if self._stopped:
+                return
+            self._stopped = True
+            thread, self._thread = self._thread, None
+        err = RuntimeError("generation scheduler stopped")
+        while True:
+            try:
+                self._q.put_nowait(_STOP)
+                break
+            except queue.Full:
+                try:
+                    item = self._q.get_nowait()
+                except queue.Empty:
+                    continue
+                if item is not _STOP:
+                    self._deliver_error(item, err)
+        if thread is not None:
+            thread.join(timeout=timeout)
+        self._drain_failed(err)
+
+    def _drain_failed(self, err: BaseException) -> None:
+        while True:
+            try:
+                item = self._q.get_nowait()
+            except queue.Empty:
+                break
+            if item is not _STOP:
+                self._deliver_error(item, err)
+        _M_WAITING.set(0)
+
+    # -- the scheduler loop --------------------------------------------------
+
+    def _loop(self) -> None:
+        err = RuntimeError("generation scheduler stopped")
+        while True:
+            # block only when fully idle; otherwise drain without waiting
+            if not self._running and not self._waiting:
+                item = self._q.get()
+                if item is _STOP or self._stopped:
+                    if item is not _STOP and item is not None:
+                        self._deliver_error(item, err)
+                    break
+                self._waiting.append(item)
+            while True:
+                try:
+                    item = self._q.get_nowait()
+                except queue.Empty:
+                    break
+                if item is _STOP:
+                    self._shutdown(err)
+                    return
+                self._waiting.append(item)
+            if self._stopped:
+                self._shutdown(err)
+                return
+            self._admit()
+            self._prefill_step()
+            self._decode_step()
+            self._publish_gauges()
+        self._shutdown(err)
+
+    def _shutdown(self, err: BaseException) -> None:
+        for s in list(self._running) + list(self._waiting):
+            self._deliver_error(s, err)
+        self._running = []
+        self._waiting = []
+        self._publish_gauges()
+
+    def _publish_gauges(self) -> None:
+        _M_RUNNING.set(len(self._running))
+        _M_WAITING.set(len(self._waiting) + self._q.qsize())
+
+    # -- admission -----------------------------------------------------------
+
+    def _admit(self) -> None:
+        """FIFO admission: the head of the waiting line enters when a
+        batch slot is free and the pool holds enough *free* blocks for
+        its prefill. Admission never preempts (only growth of already
+        -running sequences does) — an arrival that could steal blocks
+        from the sequence that just preempted FOR it would ping-pong
+        the pool forever. No head-of-line skipping either: a preempted
+        sequence parked at the front must regain its blocks before
+        anything younger runs. Expired waiters are shed wherever they
+        stand (HTTP 429 shape) — a dead deadline is dead at any queue
+        position."""
+        now = time.monotonic()
+        for s in [x for x in self._waiting if now > x.deadline]:
+            self._waiting.remove(s)
+            self._deliver_error(s, DeadlineExceededError(
+                f"deadline expired before sequence {s.id} could "
+                f"{'resume' if s.resume_decode else 'start'}"))
+        while self._waiting:
+            s = self._waiting[0]
+            if len(self._running) >= self.max_seqs:
+                break
+            if self._alloc.blocks_for(len(s.prefill_tokens) + 1) \
+                    > self._alloc.free_blocks:
+                break
+            self._waiting.pop(0)
+            s.state = "prefill"
+            s.prefilled = 0
+            s.cache_len = 0
+            self._running.append(s)
+
+    # -- prefill -------------------------------------------------------------
+
+    def _expire_running(self) -> None:
+        """The per-token contract holds for *admitted* sequences too: a
+        running sequence whose budget to the next token lapsed — a slow
+        multi-chunk prefill, or a decode iteration stretched past the
+        budget — is shed instead of holding a batch slot and burning
+        device time for a client that already gave up."""
+        now = time.monotonic()
+        for s in [x for x in self._running if now > x.deadline]:
+            self._deliver_error(s, DeadlineExceededError(
+                f"deadline expired before sequence {s.id}'s next token"))
+
+    def _prefill_step(self) -> None:
+        self._expire_running()
+        s = next((x for x in self._running if x.state == "prefill"), None)
+        if s is None:
+            return
+        total = len(s.prefill_tokens)
+        chunk = s.prefill_tokens[s.prefilled:s.prefilled + self.prefill_chunk]
+        live = len(chunk)
+        need = self._alloc.blocks_for(s.prefilled + live) - len(s.blocks)
+        if need > 0 and not self._grow(s, need):
+            return          # s itself was preempted; nothing to run
+        tokens = np.zeros((1, self.prefill_chunk), np.int32)
+        tokens[0, :live] = chunk
+        try:
+            _FP_PREFILL.fire()
+            logits = self._run(tokens,
+                               tables=self._tables([s]),
+                               lengths=np.asarray([s.prefilled], np.int32),
+                               live=np.asarray([live], np.int32))
+        except Exception as e:  # noqa: BLE001 — fails only this sequence
+            self._deliver_error(s, e)
+            return
+        _M_TOKENS.labels(phase="prefill").inc(live)
+        s.prefilled += live
+        s.cache_len = s.prefilled
+        if s.prefilled == total:
+            s.state = "decode"
+            if s.resume_decode:
+                # recompute path: the cache now holds prompt + all but
+                # the newest generated token; the next decode input is
+                # that newest token, already emitted before preemption
+                s.resume_decode = False
+                s.next_input = s.generated[-1]
+            else:
+                # the final chunk's last logits ARE the first generated
+                # token — a decode-phase token by accounting, even
+                # though the prefill program produced it
+                _M_TOKENS.labels(phase="decode").inc()
+                self._emit(s, int(np.argmax(logits[0, live - 1])))
+        if self.on_step is not None:
+            self.on_step("prefill", [s.id])
+
+    # -- decode --------------------------------------------------------------
+
+    def _decode_step(self) -> None:
+        for s in sorted([x for x in self._running if x.state == "decode"],
+                        key=lambda x: x.id):
+            if s.state != "decode":
+                continue        # preempted while growing an older peer
+            need = self._alloc.blocks_for(s.cache_len + 1) - len(s.blocks)
+            if need > 0:
+                self._grow(s, need)
+        batch = sorted([x for x in self._running if x.state == "decode"],
+                       key=lambda x: x.id)
+        if not batch:
+            return
+        B = self.max_seqs
+        tokens = np.zeros((B, DECODE_WIDTH), np.int32)
+        tables = self._tables(batch, rows=B)
+        lengths = np.zeros((B,), np.int32)
+        live = np.zeros((B,), np.int32)
+        for i, s in enumerate(batch):
+            tokens[i, 0] = s.next_input
+            lengths[i] = s.cache_len
+            live[i] = 1
+        try:
+            _FP_DECODE.fire()
+            logits = self._run(tokens, tables, lengths, live)
+        except Exception as e:  # noqa: BLE001 — fails only this batch
+            for s in batch:
+                self._deliver_error(s, e)
+            return
+        _M_OCCUPANCY.observe(len(batch))
+        _M_TOKENS.labels(phase="decode").inc(len(batch))
+        for i, s in enumerate(batch):
+            s.cache_len += 1
+            self._emit(s, int(np.argmax(logits[i, 0])))
+        if self.on_step is not None:
+            self.on_step("decode", [s.id for s in batch])
+
+    # -- shared machinery ----------------------------------------------------
+
+    def _tables(self, seqs: List[GenSequence],
+                rows: Optional[int] = None) -> np.ndarray:
+        out = np.zeros((rows or len(seqs), self.max_blocks), np.int32)
+        for i, s in enumerate(seqs):
+            out[i, :len(s.blocks)] = s.blocks
+        return out
+
+    def _run(self, tokens, tables, lengths, live):
+        from ...models.transformer import PagedCache
+        import jax.numpy as jnp
+        cache = PagedCache(self._k, self._v, jnp.asarray(tables),
+                           jnp.asarray(lengths), jnp.asarray(live))
+        try:
+            logits, cache = self._program(self._params_fn(), cache,
+                                          jnp.asarray(tokens))
+        except Exception:
+            # the pools were donated into the failed call and may be
+            # deleted — without recovery every later step would die on
+            # invalidated buffers. Widen the blast radius to the whole
+            # running set (their cache state lived in those pools) and
+            # rebuild: waiting sequences still serve next iteration.
+            self._reset_pools()
+            raise
+        self._k, self._v = cache.k, cache.v
+        return np.asarray(logits)
+
+    def _reset_pools(self) -> None:
+        import jax.numpy as jnp
+        err = RuntimeError(
+            "generation device step failed; the paged KV pools were "
+            "rebuilt and every running sequence was failed")
+        for s in list(self._running):
+            self._deliver_error(s, err)
+        self._k = jnp.zeros(self._pool_shape, self._pool_dtype)
+        self._v = jnp.zeros(self._pool_shape, self._pool_dtype)
+
+    def _grow(self, s: GenSequence, need: int) -> bool:
+        """Allocate ``need`` blocks for ``s``, preempting the youngest
+        block-holding *younger* peer on exhaustion; with none left,
+        ``s`` preempts itself. Returns False when ``s`` was preempted.
+
+        Only-younger matters: if a grower could evict an *older*
+        sequence, two sequences could evict each other forever. This
+        way age strictly wins, the oldest sequence always progresses,
+        and a self-preempted sequence is only readmitted once the block
+        it was missing is genuinely free (its re-prefill need equals
+        the allocation that just failed) — no recompute churn."""
+        while True:
+            try:
+                s.blocks.extend(self._alloc.allocate(need))
+                return True
+            except BlocksExhaustedError:
+                victims = [x for x in self._running
+                           if x.id > s.id and x.blocks]
+                if not victims:
+                    self._preempt(s)
+                    return False
+                self._preempt(max(victims, key=lambda x: x.id))
+
+    def _preempt(self, s: GenSequence) -> None:
+        """Free ``s``'s blocks and requeue it (front of the line) in
+        recompute mode. An injected ``serving.evict`` error fails the
+        evicted sequence instead — the eviction drill's failure shape."""
+        try:
+            _FP_EVICT.fire()
+        except Exception as e:  # noqa: BLE001
+            self._deliver_error(s, e)
+            return
+        self._alloc.free(s.blocks)
+        s.blocks = []
+        if s.state == "decode" and s.generated:
+            # cache must be rebuilt up to (but not including) the newest
+            # generated token — it is the resumed decode's input
+            s.prefill_tokens = s.prompt + s.generated[:-1]
+            s.resume_decode = True
+        s.prefilled = 0
+        s.cache_len = 0
+        s.state = "waiting"
+        if s in self._running:
+            self._running.remove(s)
+        self._waiting.insert(0, s)
+        _M_PREEMPTIONS.inc()
+
+    def _emit(self, s: GenSequence, token: int) -> None:
+        s.generated.append(token)
+        s.next_input = token
+        if s.deadline_s > 0:
+            s.deadline = time.monotonic() + s.deadline_s
+        s.stream_q.put(token)
+        if (s.eos_id is not None and token == s.eos_id) \
+                or len(s.generated) >= s.max_tokens:
+            self._retire(s)
+
+    def _retire(self, s: GenSequence) -> None:
+        if s.blocks:
+            self._alloc.free(s.blocks)
+            s.blocks = []
+        if s in self._running:
+            self._running.remove(s)
+        s.state = "done"
+        s.stream_q.put(_DONE)
+        s.done_event.set()
+
+    def _deliver_error(self, s: GenSequence, err: BaseException) -> None:
+        s.error = err
+        self._retire(s)

@@ -1,27 +1,40 @@
-"""The serving HTTP front-end: ``/v1/infer`` + ``/healthz``.
+"""The serving HTTP front-end: ``/v1/infer``, ``/v1/generate``,
+``/healthz``.
 
 Same stdlib idiom as the rendezvous KV server and the metrics endpoint,
 now through the shared :mod:`horovod_tpu._http` helper: a
 ``ThreadingHTTPServer`` with daemon handler threads, quiet logging, and
 idempotent stop. Each connection's handler thread blocks inside
-``engine.infer()`` until its micro-batch completes — the threaded
-server is what lets N concurrent requests coalesce into one forward.
+``engine.infer()`` / ``gen_engine.generate()`` until its work
+completes — the threaded server is what lets N concurrent requests
+coalesce into one forward (inference) or share the running decode
+batch (generation).
 
-Admission control shows up at the wire as status codes:
+Admission control shows up at the wire as status codes, identically on
+both POST routes:
 
-* ``200`` — inference served;
-* ``429`` — the request's deadline expired before its micro-batch
-  dispatched (client should slow down / shed load);
+* ``200`` — served;
+* ``429`` — the deadline expired: before the micro-batch dispatched
+  (``/v1/infer``) or before the next token was produced
+  (``/v1/generate``'s per-token extension);
 * ``503`` — the bounded queue is full (back off and retry);
-* ``400`` — malformed request (not JSON, bad shapes);
-* ``500`` — the forward itself failed (includes injected
-  ``serving.forward`` faults; the next request gets a fresh batch).
+* ``400`` — malformed request (not JSON, bad shapes, a generation
+  request that could never fit);
+* ``500`` — the forward / a decode or prefill step failed (includes
+  injected ``serving.*`` faults; the next request gets fresh state).
 
 Every response increments ``hvd_tpu_serving_requests_total{code}``.
 
-Wire format (JSON): request ``{"inputs": [[...], ...]}`` (rows of the
-model's input; optional ``"deadline_ms"``), response
-``{"outputs": [...], "step": N}``.
+Wire formats (JSON):
+
+* ``/v1/infer`` request ``{"inputs": [[...], ...]}`` (rows of the
+  model's input; optional ``"deadline_ms"``), response
+  ``{"outputs": [...], "step": N}``;
+* ``/v1/generate`` request ``{"prompt": [int, ...]}`` (optional
+  ``"max_tokens"``, ``"eos_id"``, ``"deadline_ms"``), response
+  ``{"tokens": [int, ...], "step": N}`` — ``step`` is the serving
+  checkpoint at completion (a hot-reload may land mid-sequence; decode
+  continues under the new params, see docs/inference.md).
 """
 
 import json
@@ -40,9 +53,9 @@ log = logging.getLogger("horovod_tpu.serving")
 
 _M_REQUESTS = _metrics.counter(
     "hvd_tpu_serving_requests_total",
-    "Inference HTTP requests by response code: 200 served, 429 deadline "
-    "expired, 503 queue full (admission control), 400 malformed, "
-    "500 forward failure.",
+    "Serving HTTP requests (/v1/infer and /v1/generate) by response "
+    "code: 200 served, 429 deadline expired, 503 queue full (admission "
+    "control), 400 malformed, 500 forward/decode failure.",
     labels=("code",))
 
 
@@ -61,24 +74,35 @@ class _ServingHandler(_http.QuietHandler):
             self.close_connection = True
 
     def do_GET(self):  # noqa: N802 — BaseHTTPRequestHandler contract
-        engine: InferenceEngine = self.server.engine
         if self.path.split("?", 1)[0] != "/healthz":
             self._respond(404, {"error": "not found"})
             return
-        self._respond(200, {
-            "status": "serving",
-            "step": engine.step,
-            "queue_depth": engine.queue_depth,
-        })
+        engine = self.server.engine or self.server.gen_engine
+        doc = {"status": "serving", "step": engine.step}
+        if self.server.engine is not None:
+            doc["queue_depth"] = self.server.engine.queue_depth
+        self._respond(200, doc)
 
     def do_POST(self):  # noqa: N802
-        engine: InferenceEngine = self.server.engine
-        if self.path.split("?", 1)[0] != "/v1/infer":
+        path = self.path.split("?", 1)[0]
+        if path == "/v1/infer":
+            self._infer()
+        elif path == "/v1/generate":
+            self._generate()
+        else:
             self._respond(404, {"error": "not found"})
+
+    def _read_doc(self):
+        length = int(self.headers.get("Content-Length", 0))
+        return json.loads(self.rfile.read(length))
+
+    def _infer(self) -> None:
+        engine: InferenceEngine = self.server.engine
+        if engine is None:
+            self._respond(404, {"error": "no inference engine configured"})
             return
         try:
-            length = int(self.headers.get("Content-Length", 0))
-            doc = json.loads(self.rfile.read(length))
+            doc = self._read_doc()
             x = np.asarray(doc["inputs"], dtype=np.float32)
         except (ValueError, KeyError, TypeError) as e:
             self._respond(400, {"error": f"bad request: {e}"})
@@ -104,19 +128,70 @@ class _ServingHandler(_http.QuietHandler):
         self._respond(200, {"outputs": np.asarray(out).tolist(),
                             "step": step})
 
+    def _generate(self) -> None:
+        gen = self.server.gen_engine
+        if gen is None:
+            self._respond(404, {"error": "no generation engine configured"})
+            return
+        try:
+            doc = self._read_doc()
+            prompt = [int(t) for t in doc["prompt"]]
+            max_tokens = int(doc.get("max_tokens", 16))
+            eos_id = doc.get("eos_id")
+            eos_id = None if eos_id is None else int(eos_id)
+        except (ValueError, KeyError, TypeError) as e:
+            self._respond(400, {"error": f"bad request: {e}"})
+            return
+        # admission errors are the CLIENT's (400/429/503); anything the
+        # scheduler delivers after admission — even a ValueError out of
+        # the device program — is a server-side 500, so the two phases
+        # are caught separately
+        try:
+            seq = gen.submit(prompt, max_tokens=max_tokens, eos_id=eos_id,
+                             deadline_ms=doc.get("deadline_ms"))
+        except QueueFullError as e:
+            self._respond(503, {"error": str(e)})
+            return
+        except DeadlineExceededError as e:
+            self._respond(429, {"error": str(e)})
+            return
+        except ValueError as e:         # could-never-fit, empty prompt
+            self._respond(400, {"error": str(e)})
+            return
+        try:
+            tokens = gen.result(seq)
+        except DeadlineExceededError as e:
+            self._respond(429, {"error": str(e)})
+            return
+        except Exception as e:  # noqa: BLE001 — decode failure -> 500
+            log.warning("serving: generation failed for one sequence: %s", e)
+            self._respond(500, {"error": str(e)})
+            return
+        self._respond(200, {"tokens": tokens, "step": gen.step})
+
 
 class InferenceServer:
-    """Threaded HTTP front-end over one :class:`InferenceEngine`.
+    """Threaded HTTP front-end over an :class:`InferenceEngine` and/or
+    a :class:`~horovod_tpu.serving.generation.GenerationEngine`.
 
-    ``port`` defaults to ``HVD_TPU_SERVING_PORT`` (0 = ephemeral; read
-    the bound port back from :attr:`port`). ``start()``/``stop()`` are
-    idempotent; stopping the server does not close the engine (it may
-    serve in-process callers too) — use :meth:`close` for both.
+    ``engine`` serves ``POST /v1/infer``; ``gen_engine`` serves
+    ``POST /v1/generate``; at least one is required (a route without an
+    engine answers 404). ``port`` defaults to ``HVD_TPU_SERVING_PORT``
+    (0 = ephemeral; read the bound port back from :attr:`port`).
+    ``start()``/``stop()`` are idempotent; stopping the server does not
+    close the engines (they may serve in-process callers too) — use
+    :meth:`close` for both.
     """
 
-    def __init__(self, engine: InferenceEngine, port: Optional[int] = None,
-                 addr: str = "0.0.0.0", verbose: bool = False):
+    def __init__(self, engine: Optional[InferenceEngine],
+                 port: Optional[int] = None,
+                 addr: str = "0.0.0.0", verbose: bool = False,
+                 gen_engine=None):
+        if engine is None and gen_engine is None:
+            raise ValueError(
+                "provide at least one of engine= / gen_engine=")
         self.engine = engine
+        self.gen_engine = gen_engine
         self._requested_port = int(
             _config.live_config().get(_config.SERVING_PORT)
             if port is None else port)
@@ -137,8 +212,10 @@ class InferenceServer:
                 addr=self._addr, name="hvd-tpu-serving-http",
                 verbose=self._verbose)
             self._httpd.engine = self.engine
+            self._httpd.gen_engine = self.gen_engine
             log.info("serving: HTTP front-end on %s:%d (step %d)",
-                     self._addr, self.port, self.engine.step)
+                     self._addr, self.port,
+                     (self.engine or self.gen_engine).step)
         return self.port
 
     def stop(self) -> None:
@@ -147,7 +224,10 @@ class InferenceServer:
 
     def close(self) -> None:
         self.stop()
-        self.engine.close()
+        if self.engine is not None:
+            self.engine.close()
+        if self.gen_engine is not None:
+            self.gen_engine.close()
 
     def __enter__(self):
         self.start()

@@ -1,7 +1,7 @@
 #!/usr/bin/env python
 """Collective-plane microbenchmark driver (VERDICT r3 item 2).
 
-Runs four sections, each in killable CPU subprocesses, and writes
+Runs five sections, each in killable CPU subprocesses, and writes
 ``MICROBENCH.json``:
 
 1. ``eager_1proc``  — payload sweep of the eager plane with one process:
@@ -22,9 +22,15 @@ Runs four sections, each in killable CPU subprocesses, and writes
    reduction, with analytic wire bytes per variant. Each row carries the
    same-scale eager bucketed time (section 1/2) so the eager-vs-compiled
    gap for the REAL optimizer payload is a single recorded number.
+5. ``generation``   — continuous batching vs static full-batch
+   generation (docs/inference.md) on a mixed-length prompt workload,
+   both modes driving the same compiled paged prefill/decode programs:
+   useful tokens/sec and peak KV bytes (allocator high-water vs the
+   static max-length reservation).
 
 Usage: ``python microbench.py [--quick]``. Workers are internal
-(``--worker-eager`` / ``--worker-scaling`` / ``--worker-injit``).
+(``--worker-eager`` / ``--worker-scaling`` / ``--worker-injit`` /
+``--worker-generation``).
 """
 
 import json
@@ -166,6 +172,32 @@ def worker_injit(n: int, quick: bool) -> int:
     return 0
 
 
+def worker_generation(quick: bool) -> int:
+    from horovod_tpu.microbench import generation_sweep
+    row = generation_sweep(num_requests=12 if quick else 24)
+    print(MB_TAG + json.dumps(row))
+    return 0
+
+
+def _run_generation(quick: bool, timeout: int):
+    p = None
+    cmd = [sys.executable, os.path.abspath(__file__), "--worker-generation"]
+    if quick:
+        cmd.append("--quick")
+    try:
+        p = subprocess.run(cmd, env=_cpu_env(), text=True,
+                           capture_output=True, timeout=timeout)
+    except subprocess.TimeoutExpired:
+        _log("generation: timeout")
+        return None
+    sys.stderr.write(p.stderr or "")
+    if p.returncode != 0:
+        _log(f"generation: rc={p.returncode}")
+        return None
+    rows = _collect(p.stdout or "")
+    return rows[0] if rows else None
+
+
 def _run_injit(n: int, quick: bool, timeout: int):
     env = _cpu_env({
         "XLA_FLAGS": f"--xla_force_host_platform_device_count={n}",
@@ -198,6 +230,8 @@ def main():
             return worker_scaling(int(a.split("=", 1)[1]), quick)
         if a.startswith("--worker-injit="):
             return worker_injit(int(a.split("=", 1)[1]), quick)
+        if a == "--worker-generation":
+            return worker_generation(quick)
 
     t0 = time.time()
     result = {"quick": quick}
@@ -209,15 +243,15 @@ def main():
         bk = next((r for r in rows if "scenario" in r), None)
         return plain, bk
 
-    _log("section 1/3: eager sweep, 1 process")
+    _log("section 1/5: eager sweep, 1 process")
     result["eager_1proc"], result["bucketed_1proc"] = split_bucketed(
         _run_eager(1, quick, timeout=600))
 
-    _log("section 2/3: eager sweep, 2 processes")
+    _log("section 2/5: eager sweep, 2 processes")
     result["eager_2proc"], result["bucketed_2proc"] = split_bucketed(
         _run_eager(2, quick, timeout=900))
 
-    _log("section 3/4: compiled-plane scaling sweep")
+    _log("section 3/5: compiled-plane scaling sweep")
     points = []
     for n in (1, 2, 4, 8):
         row = _run_scaling(n, quick, timeout=600)
@@ -232,7 +266,7 @@ def main():
                 / (p["num_devices"] * base["images_per_sec_total"]), 3)
     result["scaling"] = points
 
-    _log("section 4/4: in-jit fast path (ResNet-50 gradient scenario)")
+    _log("section 4/5: in-jit fast path (ResNet-50 gradient scenario)")
     injit_rows = []
     for n in ((1, 2) if quick else (1, 2, 8)):
         row = _run_injit(n, quick, timeout=900)
@@ -253,6 +287,15 @@ def main():
                  f"{row['variants']['packed']['time_s'] * 1e3:.1f} ms "
                  f"(x{row['packed_speedup_vs_per_leaf']} vs per-leaf)")
     result["injit"] = injit_rows
+
+    _log("section 5/5: continuous vs static batch generation")
+    gen = _run_generation(quick, timeout=600)
+    if gen:
+        _log(f"  continuous {gen['continuous']['tokens_per_s']} tok/s "
+             f"(x{gen['continuous_speedup']} vs static full-batch), "
+             f"peak KV {gen['kv_bytes_vs_static_reservation']} of the "
+             f"static reservation")
+    result["generation"] = gen
     result["wall_s"] = round(time.time() - t0, 1)
 
     out_path = os.path.join(ROOT, "MICROBENCH.json")
@@ -280,6 +323,10 @@ def main():
             inj2["variants"]["packed"]["time_s"] * 1e3, 1) if inj2 else None,
         "injit_packed_vs_eager_bucketed": inj2.get(
             "packed_speedup_vs_eager_bucketed") if inj2 else None,
+        "gen_continuous_tokens_per_s": gen["continuous"]["tokens_per_s"]
+        if gen else None,
+        "gen_speedup_vs_static_batch": gen["continuous_speedup"]
+        if gen else None,
     }))
     return 0
 

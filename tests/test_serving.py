@@ -393,7 +393,8 @@ class TestInferenceEngine:
             out = np.asarray(eng.infer(_rows(1), timeout=10))
             np.testing.assert_allclose(out, np.full((1, OUT_DIM), 8.0),
                                        atol=1e-6)
-        assert M.snapshot()["hvd_tpu_serving_checkpoint_step"] == 2
+        assert M.snapshot()[
+            'hvd_tpu_serving_checkpoint_step{plane="inference"}'] == 2
 
     def test_empty_dir_raises_up_front(self, tmp_path):
         with pytest.raises(FileNotFoundError):
@@ -421,7 +422,9 @@ class TestInferenceEngine:
             out = np.asarray(eng.infer(_rows(1), timeout=10))
             np.testing.assert_allclose(out, np.full((1, OUT_DIM), 8.0),
                                        atol=1e-6)
-        assert _delta(before, "hvd_tpu_serving_hot_swaps_total") == 1
+        assert _delta(
+            before,
+            'hvd_tpu_serving_hot_swaps_total{plane="inference"}') == 1
 
     def test_background_poll_hot_reloads_without_dropping_requests(
             self, tmp_path):
