@@ -38,12 +38,14 @@ COMMON_SUITES = [
      "python -m pytest tests/ -q -m 'not integration and not chaos' "
      "--ignore=tests/test_checkpointing.py "
      "--ignore=tests/test_serving.py "
-     "--ignore=tests/test_generation.py", 30),
+     "--ignore=tests/test_generation.py "
+     "--ignore=tests/test_generation_sampling.py", 30),
     ("chaos", "python -m pytest tests/ -q -m chaos "
      "--ignore=tests/test_coordinator_recovery.py "
      "--ignore=tests/test_checkpointing.py "
      "--ignore=tests/test_serving.py "
-     "--ignore=tests/test_generation.py", 20),
+     "--ignore=tests/test_generation.py "
+     "--ignore=tests/test_generation_sampling.py", 20),
     # coordinator-kill + heartbeat-timeout drills, seeded so every run
     # replays the same fault schedule; owns its test file exclusively
     # (the generic chaos suite ignores it to avoid double runs)
@@ -64,12 +66,14 @@ COMMON_SUITES = [
      "env HVD_TPU_FAULT_SEED=1234 "
      "python -m pytest tests/test_serving.py -q", 20),
     # continuous-batching generation: paged KV cache, decode/full-forward
-    # parity, preemption, and the seeded prefill/decode/evict chaos
-    # drills — pinned seed; owns its file exclusively (unit+chaos+serving
-    # suites ignore it)
+    # parity, preemption, the seeded prefill/decode/evict chaos drills,
+    # and the device-resident loop suite (on-device sampling, seeded
+    # determinism, async stepping) — pinned seed; owns its files
+    # exclusively (unit+chaos+serving suites ignore them)
     ("serving-gen",
      "env HVD_TPU_FAULT_SEED=1234 "
-     "python -m pytest tests/test_generation.py -q", 20),
+     "python -m pytest tests/test_generation.py "
+     "tests/test_generation_sampling.py -q", 20),
     ("multiproc",
      "python -m pytest tests/test_multiprocess_integration.py -q", 30),
     ("elastic", "python -m pytest tests/test_elastic_e2e.py -q", 40),

@@ -500,6 +500,18 @@ GEN_DEADLINE_MS = _register(
          "that waits longer — parked at admission or preempted and "
          "awaiting blocks — fails with the serving plane's deadline "
          "error (HTTP 429). 0 disables deadlines.")
+GEN_ASYNC_DEPTH = _register(
+    "GEN_ASYNC_DEPTH", 1, int,
+    help="Decode steps the generation scheduler enqueues ahead of the "
+         "one it is waiting on (JAX async dispatch): at the default 1, "
+         "step N+1 is speculatively in flight while the host consumes "
+         "step N's token vector, overlapping retire/admit/stream "
+         "delivery with device compute — a lane retired by step N "
+         "already routed step N+1's writes to the null block on "
+         "device, so speculation never corrupts the cache. 0 restores "
+         "the fully synchronous loop (debugging); values above 1 are "
+         "clamped to 1 (depth-1 reconciliation is what the scheduler "
+         "implements).")
 
 # -- Misc -------------------------------------------------------------------
 NUM_STREAMS = _register(

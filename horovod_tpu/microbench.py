@@ -399,9 +399,12 @@ def generation_sweep(num_requests: int = 24, batch_slots: int = 8,
     """Continuous batching vs static full-batch generation on a
     mixed-length prompt workload (ROADMAP item 1's acceptance pair).
 
-    Both modes drive the *same* compiled paged prefill/decode programs
-    (``serving.generation.build_program``), so the measured gap is pure
-    scheduling + memory policy, not kernel differences:
+    Both modes run the same paged forward over the same pool shapes —
+    static through the raw-logits ``build_program``, continuous through
+    the engine's on-device-sampling programs (whose greedy tokens are
+    pinned bit-identical to host argmax) — and every program is warmed
+    off-clock, so the measured gap is pure scheduling + memory policy,
+    not kernel or compile-time differences:
 
     * **static** — the classic served-systems baseline: requests form
       batches of ``batch_slots`` in arrival order; each batch prefills,
@@ -545,8 +548,11 @@ def generation_sweep(num_requests: int = 24, batch_slots: int = 8,
         assert leaked == 0, f"{leaked} KV blocks leaked"
         return wall, peak, steps, occupancy, preempt, outs
 
-    # compile both program shapes before any clock starts
+    # compile every program before any clock starts: the static baseline
+    # uses the raw-logits build_program shapes, the engine the sampled
+    # prefill/decode programs — warm both modes off-clock
     run_static()
+    run_continuous()
     st_wall, st_peak, st_steps, st_outs = run_static()
     ct_wall, ct_peak, ct_steps, ct_occ, ct_preempt, ct_outs = \
         run_continuous()
@@ -583,4 +589,119 @@ def generation_sweep(num_requests: int = 24, batch_slots: int = 8,
         "continuous_speedup": round(st_wall / ct_wall, 2),
         "kv_bytes_vs_static_reservation": round(ct_peak / st_peak, 3)
         if st_peak else None,
+    }
+
+
+def sampling_sweep(num_requests: int = 16, batch_slots: int = 8,
+                   block_size: int = 8) -> dict:
+    """On-device sampling modes under sync vs async stepping (ISSUE 11).
+
+    Same tiny model and mixed-length workload class as
+    :func:`generation_sweep`, driven through the
+    :class:`GenerationEngine` in four modes: ``greedy`` vs ``sampled``
+    (temperature + top-k + top-p, seeded per request), each at
+    ``async_depth`` 0 (synchronous) and 1 (double-buffered). Reported
+    per mode: wall seconds, useful tokens/sec, and the host/device
+    milliseconds per scheduler iteration read from the
+    ``hvd_tpu_gen_step_seconds{component}`` histogram deltas — the
+    before/after for the ROADMAP's live-TPU host-overhead re-measure.
+    Each sampling mode's outputs are asserted identical across depths
+    (depth-1 reconciliation must not change a single token).
+    """
+    import threading
+
+    import jax
+    import jax.numpy as jnp
+
+    from .models.transformer import Transformer, TransformerConfig
+    from .serving.generation import GenerationEngine
+    from . import metrics as _metrics
+
+    cfg = TransformerConfig(vocab_size=512, num_layers=4, d_model=128,
+                            num_heads=4, head_dim=32, max_seq_len=128,
+                            dtype=jnp.float32)
+    model = Transformer(cfg)
+    rng = np.random.RandomState(0)
+    params = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 4), jnp.int32))
+    new_lens = [(32, 4, 4, 4, 8, 4, 16, 4)[i % 8]
+                for i in range(num_requests)]
+    prompts = [rng.randint(0, cfg.vocab_size,
+                           (4 + (i * 5) % 20,)).tolist()
+               for i in range(num_requests)]
+    total_new = sum(new_lens)
+    max_blocks = -(-cfg.max_seq_len // block_size)
+    sampled_kw = dict(temperature=0.9, top_k=32, top_p=0.9)
+
+    def run_mode(sampled: bool, async_depth: int):
+        snap0 = _metrics.snapshot()
+        engine = GenerationEngine(
+            model, params=params, block_size=block_size,
+            num_blocks=batch_slots * max_blocks + 1, max_seqs=batch_slots,
+            prefill_chunk=16, queue_depth=num_requests, deadline_ms=0,
+            async_depth=async_depth)
+        outs = [None] * num_requests
+        t0 = time.perf_counter()
+
+        def client(i):
+            kw = dict(sampled_kw, seed=1000 + i) if sampled else {}
+            outs[i] = engine.generate(prompts[i], max_tokens=new_lens[i],
+                                      timeout=600, **kw)
+
+        threads = [threading.Thread(target=client, args=(i,))
+                   for i in range(num_requests)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        wall = time.perf_counter() - t0
+        snap1 = _metrics.snapshot()
+        leaked = engine.allocator.in_use
+        engine.close()
+        assert leaked == 0, f"{leaked} KV blocks leaked"
+        split = {}
+        for comp in ("host", "device"):
+            key = f'hvd_tpu_gen_step_seconds{{component="{comp}"}}'
+            h0 = snap0.get(key, {"sum": 0.0, "count": 0})
+            h1 = snap1.get(key, {"sum": 0.0, "count": 0})
+            iters = h1["count"] - h0["count"]
+            split[comp] = (h1["sum"] - h0["sum"]) / max(1, iters)
+            split["iters"] = int(iters)
+        return {
+            "wall_s": round(wall, 3),
+            "tokens_per_s": round(total_new / wall, 1),
+            "scheduler_iters": split["iters"],
+            "host_ms_per_step": round(split["host"] * 1e3, 3),
+            "device_ms_per_step": round(split["device"] * 1e3, 3),
+        }, outs
+
+    modes = {}
+    outputs = {}
+    # compile both programs (and warm the jit caches) off the clock
+    run_mode(sampled=False, async_depth=0)
+    run_mode(sampled=True, async_depth=0)
+    for name, sampled, depth in (("greedy_sync", False, 0),
+                                 ("greedy_async1", False, 1),
+                                 ("sampled_sync", True, 0),
+                                 ("sampled_async1", True, 1)):
+        modes[name], outputs[name] = run_mode(sampled, depth)
+    # depth-1 reconciliation must be invisible in the outputs
+    assert outputs["greedy_sync"] == outputs["greedy_async1"], \
+        "greedy outputs diverged between sync and async stepping"
+    assert outputs["sampled_sync"] == outputs["sampled_async1"], \
+        "seeded sampled outputs diverged between sync and async stepping"
+
+    return {
+        "scenario": "on_device_sampling",
+        "num_requests": num_requests,
+        "batch_slots": batch_slots,
+        "block_size": block_size,
+        "total_new_tokens": total_new,
+        "sampled_params": sampled_kw,
+        "modes": modes,
+        "async_speedup_greedy": round(
+            modes["greedy_sync"]["wall_s"]
+            / modes["greedy_async1"]["wall_s"], 2),
+        "async_speedup_sampled": round(
+            modes["sampled_sync"]["wall_s"]
+            / modes["sampled_async1"]["wall_s"], 2),
     }

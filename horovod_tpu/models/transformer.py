@@ -30,7 +30,12 @@ static across steps — the jit cache sees exactly two programs, one per
 phase. The paged read path deliberately reuses
 :func:`_default_attention` so decode logits are bit-identical to the
 full-sequence forward (``attention_fn`` injection is a training-side
-hook and is not consulted during paged decode).
+hook and is not consulted during paged decode). The paged path also
+takes an optional ``logits_at`` ``(B,)`` position index: the vocab
+projection then runs only at that position per row and returns
+``(B, vocab)`` logits — the serving sampling programs use it so the
+full ``(B, C, vocab)`` logits tensor never materializes on the decode
+hot path (the selected row stays bit-identical to the full projection).
 """
 
 import dataclasses
@@ -192,7 +197,7 @@ class Transformer(nn.Module):
     cfg: TransformerConfig
 
     @nn.compact
-    def __call__(self, tokens, cache=None):
+    def __call__(self, tokens, cache=None, logits_at=None):
         cfg = self.cfg
         B, S = tokens.shape
         emb = self.param("embedding", nn.with_logical_partitioning(
@@ -239,9 +244,22 @@ class Transformer(nn.Module):
                 v_pool = v_pool.at[i].set(v_i)
         x = nn.LayerNorm(dtype=cfg.dtype, param_dtype=jnp.float32,
                          name="ln_f")(x)
+        if cache is not None and logits_at is not None:
+            # paged serving fast path: the caller only samples one
+            # position per row, so project just that position into the
+            # vocab — the projection shrinks by the chunk factor and the
+            # (B, C, V) logits tensor never materializes. The einsum
+            # below reduces over the same 'e' axis with the same
+            # contraction order, so the selected row's logits stay
+            # bit-identical to the full projection (tests pin it).
+            x = jnp.take_along_axis(
+                x, logits_at.astype(jnp.int32)[:, None, None], axis=1)
         # logits in fp32, weight-tied to the embedding
         logits = jnp.einsum("bse,ve->bsv", x.astype(jnp.float32),
                             emb.astype(jnp.float32))
         if cache is None:
             return logits
+        if logits_at is not None:
+            return logits[:, 0], dataclasses.replace(cache, k=k_pool,
+                                                     v=v_pool)
         return logits, dataclasses.replace(cache, k=k_pool, v=v_pool)

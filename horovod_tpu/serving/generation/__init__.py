@@ -8,12 +8,16 @@ same admission machinery:
 
 * :mod:`.kv_cache` — paged KV cache: fixed-size block pools
   (``HVD_TPU_GEN_BLOCK_SIZE`` x ``HVD_TPU_GEN_NUM_BLOCKS``), a strict
-  block allocator, and the one jitted incremental forward both phases
-  share;
+  block allocator, and the jitted prefill/decode programs — both
+  **sample on device** (greedy/temperature/top-k/top-p, seeded per
+  request) and return ``(B,)`` token ids + logprobs, never logits;
 * :mod:`.scheduler` — :class:`ContinuousBatcher`: iteration-level
   scheduling (admit / one prefill chunk / one decode step, every step),
   immediate retirement on EOS or ``max_tokens``, preempt-and-requeue on
-  block exhaustion, per-token deadlines;
+  block exhaustion, per-token deadlines; decode state lives on device
+  (re-uploaded only on batch membership changes) and
+  ``HVD_TPU_GEN_ASYNC_DEPTH=1`` overlaps host scheduling with the
+  in-flight device step;
 * :mod:`.engine` — :class:`GenerationEngine`: the scheduler glued to
   the shared checkpoint restore + hot-reload lifecycle
   (:class:`~horovod_tpu.serving.engine.ParamsLifecycle`).
@@ -36,5 +40,7 @@ See docs/inference.md for architecture, knobs, metrics, and drills.
 
 from .engine import GenerationEngine                        # noqa: F401
 from .kv_cache import (BlockAllocator, BlocksExhaustedError,  # noqa: F401
-                       block_bytes, build_program, make_pools)
+                       DecodeState, SampleParams, block_bytes,
+                       build_decode_program, build_prefill_program,
+                       build_program, make_pools, sample_tokens)
 from .scheduler import ContinuousBatcher, GenSequence       # noqa: F401

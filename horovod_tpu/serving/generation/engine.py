@@ -27,8 +27,9 @@ from typing import Any, List, Optional, Sequence
 
 from ... import config as _config
 from ..engine import ParamsLifecycle
-from .kv_cache import BlockAllocator, build_program, make_pools
-from .scheduler import ContinuousBatcher, GenSequence
+from .kv_cache import (BlockAllocator, build_decode_program,
+                       build_prefill_program, make_pools)
+from .scheduler import DECODE_WIDTH, ContinuousBatcher, GenSequence
 
 
 class GenerationEngine:
@@ -42,12 +43,16 @@ class GenerationEngine:
         ``params`` and ``checkpoint_dir``.
       eos_id: default EOS token id for submitted sequences (per-request
         override wins; None runs every sequence to its ``max_tokens``).
+      async_depth: decode steps the scheduler keeps in flight past the
+        one being consumed (0 = synchronous; see
+        ``HVD_TPU_GEN_ASYNC_DEPTH``).
       on_step: optional scheduler observability hook
         (``on_step(phase, [seq_id, ...])``).
 
     Knob-backed arguments (``block_size``, ``num_blocks``, ``max_seqs``,
-    ``prefill_chunk``, ``queue_depth``, ``deadline_ms``) default to
-    their registered generation knobs (docs/configuration.md).
+    ``prefill_chunk``, ``queue_depth``, ``deadline_ms``,
+    ``async_depth``) default to their registered generation knobs
+    (docs/configuration.md).
     """
 
     def __init__(self, model, checkpoint_dir: Optional[str] = None,
@@ -60,6 +65,7 @@ class GenerationEngine:
                  prefill_chunk: Optional[int] = None,
                  queue_depth: Optional[int] = None,
                  deadline_ms: Optional[float] = None,
+                 async_depth: Optional[int] = None,
                  reload_poll_seconds: Optional[float] = None,
                  on_step=None):
         cfg = _config.live_config()
@@ -75,26 +81,38 @@ class GenerationEngine:
         self.allocator = BlockAllocator(num_blocks, block_size)
         pools = make_pools(model.cfg, num_blocks, block_size)
         self.batcher = ContinuousBatcher(
-            build_program(model),
+            (build_prefill_program(model),
+             build_decode_program(model, DECODE_WIDTH)),
             lambda: self._lifecycle.snapshot()[0],
             pools, self.allocator,
             max_seq_len=model.cfg.max_seq_len, max_seqs=max_seqs,
             prefill_chunk=prefill_chunk, queue_depth=queue_depth,
             deadline_ms=deadline_ms, eos_id=eos_id,
-            vocab_size=model.cfg.vocab_size, on_step=on_step)
+            vocab_size=model.cfg.vocab_size, async_depth=async_depth,
+            on_step=on_step)
         self._lifecycle.start_poller()    # last: nothing can fail past here
 
     # -- generation ----------------------------------------------------------
 
     def submit(self, prompt: Sequence[int], max_tokens: int = 16,
                eos_id: Optional[int] = None,
-               deadline_ms: Optional[float] = None) -> GenSequence:
+               deadline_ms: Optional[float] = None,
+               temperature: Optional[float] = None,
+               top_k: Optional[int] = None,
+               top_p: Optional[float] = None,
+               seed: Optional[int] = None) -> GenSequence:
         """Admit one request; returns the sequence handle for
         :meth:`result` / :meth:`stream`. Raises ``QueueFullError``
         (503) / ``DeadlineExceededError`` (429) / ``ValueError``
-        (400) with the serving plane's admission semantics."""
+        (400) with the serving plane's admission semantics. Sampling
+        runs on device: ``temperature`` (None/0 = greedy), ``top_k``,
+        ``top_p``, and ``seed`` (deterministic continuations, also
+        across a preemption-recompute) — see
+        :meth:`ContinuousBatcher.submit`."""
         return self.batcher.submit(prompt, max_tokens=max_tokens,
-                                   eos_id=eos_id, deadline_ms=deadline_ms)
+                                   eos_id=eos_id, deadline_ms=deadline_ms,
+                                   temperature=temperature, top_k=top_k,
+                                   top_p=top_p, seed=seed)
 
     def result(self, seq: GenSequence,
                timeout: Optional[float] = None) -> List[int]:
@@ -103,19 +121,30 @@ class GenerationEngine:
     def stream(self, prompt: Sequence[int], max_tokens: int = 16,
                eos_id: Optional[int] = None,
                deadline_ms: Optional[float] = None,
+               temperature: Optional[float] = None,
+               top_k: Optional[int] = None,
+               top_p: Optional[float] = None,
+               seed: Optional[int] = None,
                timeout: Optional[float] = None):
         """submit + yield tokens as the scheduler emits them."""
         seq = self.submit(prompt, max_tokens=max_tokens, eos_id=eos_id,
-                          deadline_ms=deadline_ms)
+                          deadline_ms=deadline_ms, temperature=temperature,
+                          top_k=top_k, top_p=top_p, seed=seed)
         return self.batcher.stream(seq, timeout=timeout)
 
     def generate(self, prompt: Sequence[int], max_tokens: int = 16,
                  eos_id: Optional[int] = None,
                  deadline_ms: Optional[float] = None,
+                 temperature: Optional[float] = None,
+                 top_k: Optional[int] = None,
+                 top_p: Optional[float] = None,
+                 seed: Optional[int] = None,
                  timeout: Optional[float] = None) -> List[int]:
         """Blocking generation: prompt tokens in, generated tokens out."""
         return self.batcher.generate(prompt, max_tokens=max_tokens,
                                      eos_id=eos_id, deadline_ms=deadline_ms,
+                                     temperature=temperature, top_k=top_k,
+                                     top_p=top_p, seed=seed,
                                      timeout=timeout)
 
     # -- lifecycle -----------------------------------------------------------

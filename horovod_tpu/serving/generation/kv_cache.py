@@ -26,12 +26,14 @@ caused it, instead of surfacing as silent cache corruption under load.
 microbench compares against a dense reservation.
 """
 
+import dataclasses
 import functools
 import math
-from typing import List
+from typing import Any, List
 
 from ... import _locks
 from ... import metrics as _metrics
+from ...models.transformer import PagedCache
 
 _M_BLOCKS = _metrics.gauge(
     "hvd_tpu_gen_kv_blocks_in_use",
@@ -142,16 +144,22 @@ def block_bytes(model_cfg, block_size: int) -> int:
 
 @functools.lru_cache(maxsize=8)
 def build_program(model):
-    """The one jitted incremental forward both phases share.
+    """The raw-logits jitted incremental forward.
 
     ``(params, PagedCache, tokens) -> (logits, PagedCache)``; the cache
     argument is donated so XLA updates the pools in place. Called with
     ``tokens`` of shape ``(1, prefill_chunk)`` it is the prefill
     program; with ``(max_seqs, DECODE_WIDTH)`` it is the decode
-    program — two compilations of one function, and the only two the
-    jit cache ever sees (every other shape is static). Memoized on the
-    model (flax modules hash by configuration), so engine restarts and
-    tests don't recompile identical programs.
+    program — two compilations of one function. Memoized on the model
+    (flax modules hash by configuration), so engine restarts and tests
+    don't recompile identical programs.
+
+    The scheduler's hot path no longer runs this program — it drives
+    :func:`build_prefill_program` / :func:`build_decode_program`, which
+    sample on device and never ship logits to the host. This one stays
+    as the reference surface: the bit-parity tests pin the sampling
+    programs' greedy tokens against its host-side ``argmax``, and the
+    microbench's static baseline drives it directly.
     """
     import jax
 
@@ -159,3 +167,192 @@ def build_program(model):
         return model.apply(params, tokens, cache=cache)
 
     return jax.jit(_paged_forward, donate_argnums=(1,))
+
+
+# -- on-device sampling ------------------------------------------------------
+
+@dataclasses.dataclass(frozen=True)
+class SampleParams:
+    """Per-lane sampling controls, resident on device.
+
+    ``temperature`` ``(B,)`` float32 — ``<= 0`` selects greedy argmax
+    (bit-identical to host ``np.argmax`` of the raw logits).
+    ``top_k`` ``(B,)`` int32 — keep the k highest-scoring tokens
+    (``<= 0`` disables). ``top_p`` ``(B,)`` float32 — nucleus mass
+    (``>= 1`` disables; the top token always survives). ``key``
+    ``(B, 2)`` uint32 — the per-request PRNG key; every emission folds
+    the emitted-token ordinal into it (``jax.random.fold_in``), so a
+    continuation is a pure function of (seed, position) and the
+    preemption-recompute path replays the identical tokens. ``emitted``
+    ``(B,)`` int32 — that ordinal (== tokens generated so far).
+    """
+
+    temperature: Any
+    top_k: Any
+    top_p: Any
+    key: Any
+    emitted: Any
+
+
+@dataclasses.dataclass(frozen=True)
+class DecodeState:
+    """The device-resident decode loop state, one row per batch lane.
+
+    The decode program consumes and re-emits it (donated), feeding each
+    lane's sampled token back as the next input in place: ``tokens``
+    ``(B,)`` int32 next-input ids, ``lengths`` ``(B,)`` int32 cache
+    lengths, ``live`` ``(B,)`` int32 lane-occupied mask, ``remaining``
+    ``(B,)`` int32 tokens still to emit, ``eos`` ``(B,)`` int32 EOS id
+    (-1 = none), and the :class:`SampleParams`. Retirement (EOS or
+    ``max_tokens``) is decided *inside* the program — a retired lane's
+    ``live`` drops to 0 on device, so a speculatively enqueued next
+    step routes its writes to the null block with no host round-trip.
+    The host only rebuilds and re-uploads this state when batch
+    membership changes (admit/retire/preempt), keyed by a batch epoch.
+    """
+
+    tokens: Any
+    lengths: Any
+    live: Any
+    remaining: Any
+    eos: Any
+    sample: SampleParams
+
+
+def _register_pytrees():
+    import jax
+    jax.tree_util.register_dataclass(
+        SampleParams,
+        data_fields=["temperature", "top_k", "top_p", "key", "emitted"],
+        meta_fields=[])
+    jax.tree_util.register_dataclass(
+        DecodeState,
+        data_fields=["tokens", "lengths", "live", "remaining", "eos",
+                     "sample"],
+        meta_fields=[])
+
+
+_register_pytrees()
+
+
+def sample_tokens(logits, sample: SampleParams):
+    """Select one token per row from ``(B, vocab)`` logits, on device.
+
+    Greedy rows (``temperature <= 0``) take ``argmax``; sampled rows
+    scale by temperature, apply top-k then top-p restriction, and draw
+    categorically under the row's folded PRNG key. Returns
+    ``(token (B,) int32, logprob (B,) float32)`` — the logprob is under
+    the *unmodified* distribution, so observability reads the model's
+    actual confidence, not the truncated one.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    vocab = logits.shape[-1]
+    greedy = sample.temperature <= 0.0
+    argmax_tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
+    def _draw(_):
+        scaled = logits / jnp.where(greedy, 1.0,
+                                    sample.temperature)[:, None]
+        # top-k: threshold at the k-th highest score (k <= 0 keeps all)
+        srt = jnp.sort(scaled, axis=-1)[:, ::-1]
+        k_eff = jnp.clip(jnp.where(sample.top_k <= 0, vocab,
+                                   sample.top_k), 1, vocab)
+        kth = jnp.take_along_axis(srt, (k_eff - 1)[:, None], axis=-1)
+        limited = jnp.where(scaled < kth, -jnp.inf, scaled)
+        # top-p: smallest prefix of the sorted survivors holding >= p
+        # mass; the exclusive cumsum always keeps the top token
+        probs = jax.nn.softmax(limited, axis=-1)
+        psort = jnp.sort(probs, axis=-1)[:, ::-1]
+        csum = jnp.cumsum(psort, axis=-1)
+        keep = jnp.sum((csum - psort) < sample.top_p[:, None], axis=-1)
+        thresh = jnp.take_along_axis(
+            psort, (jnp.maximum(keep, 1) - 1)[:, None], axis=-1)
+        limited = jnp.where(
+            (sample.top_p < 1.0)[:, None] & (probs < thresh),
+            -jnp.inf, limited)
+        keys = jax.vmap(jax.random.fold_in)(sample.key, sample.emitted)
+        drawn = jax.vmap(jax.random.categorical)(keys, limited)
+        return drawn.astype(jnp.int32)
+
+    # all-greedy batches skip the two vocab sorts + categorical draw at
+    # runtime; sampled lanes run the identical ops either way, so the
+    # per-seed draw is unchanged by the branch
+    drawn = jax.lax.cond(jnp.any(~greedy), _draw,
+                         lambda _: argmax_tok, operand=None)
+    token = jnp.where(greedy, argmax_tok, drawn)
+    logprob = jnp.take_along_axis(
+        jax.nn.log_softmax(logits, axis=-1), token[:, None], axis=-1)[:, 0]
+    return token, logprob
+
+
+@functools.lru_cache(maxsize=8)
+def build_prefill_program(model):
+    """The sampling prefill program:
+    ``(params, PagedCache, tokens, SampleParams) ->
+    (token (B,), logprob (B,), PagedCache)``.
+
+    One chunk of prompt K/V lands in the cache and the *final* live
+    position's next token is sampled on device — the host never sees
+    chunk logits, so intermediate chunks don't even synchronize. The
+    cache is donated; ``tokens`` is ``(1, prefill_chunk)``.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    def _prefill(params, cache, tokens, sample):
+        at = jnp.maximum(cache.live - 1, 0).astype(jnp.int32)
+        logits, cache = model.apply(params, tokens, cache=cache,
+                                    logits_at=at)
+        token, logprob = sample_tokens(logits, sample)
+        return token, logprob, cache
+
+    return jax.jit(_prefill, donate_argnums=(1,))
+
+
+@functools.lru_cache(maxsize=8)
+def build_decode_program(model, decode_width: int = 2):
+    """The device-resident decode step:
+    ``(params, k, v, tables, DecodeState) ->
+    (k, v, DecodeState, token (B,), logprob (B,))``.
+
+    One fixed-shape step over every lane: write K/V at each live lane's
+    cache position (dead lanes route to the null block), sample the
+    next token, and advance the state *in place* — sampled tokens feed
+    back as the next inputs, lengths/remaining/emitted tick forward,
+    and lanes hitting EOS or ``max_tokens`` drop their own ``live``
+    flag so a speculatively enqueued next step is already harmless.
+    ``k``/``v`` and the state are donated (the persistent device
+    buffers); ``tables`` is NOT — the host re-uploads it only when a
+    block table actually changed, and block growth alone never forces
+    a pipeline flush. The per-step device->host transfer is the
+    ``(B,)`` token and logprob vectors — never logits.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    def _decode(params, k, v, tables, state):
+        B = state.tokens.shape[0]
+        tokens = jnp.zeros((B, decode_width), jnp.int32)
+        tokens = tokens.at[:, 0].set(state.tokens)
+        live = jnp.minimum(state.live, 1).astype(jnp.int32)
+        cache = PagedCache(k, v, tables, state.lengths, live)
+        logits, cache = model.apply(params, tokens, cache=cache,
+                                    logits_at=jnp.zeros((B,), jnp.int32))
+        sampled, logprob = sample_tokens(logits, state.sample)
+        alive = live > 0
+        token = jnp.where(alive, sampled, state.tokens)
+        retired = alive & (((state.eos >= 0) & (token == state.eos))
+                           | (state.remaining <= 1))
+        new_state = DecodeState(
+            tokens=token,
+            lengths=state.lengths + live,
+            live=jnp.where(retired, 0, live),
+            remaining=state.remaining - live,
+            eos=state.eos,
+            sample=dataclasses.replace(
+                state.sample, emitted=state.sample.emitted + live))
+        return cache.k, cache.v, new_state, token, logprob
+
+    return jax.jit(_decode, donate_argnums=(1, 2, 4))

@@ -1,4 +1,4 @@
-"""Iteration-level scheduling: the continuous-batching decode loop.
+"""Iteration-level scheduling: the device-resident continuous decode loop.
 
 The PR 5 micro-batcher forms a batch once and rides it to completion —
 right for fixed-shape forwards, wrong for autoregressive decode, where
@@ -20,13 +20,35 @@ Each scheduler iteration does three things, in order:
    ``max_tokens``) retire *immediately*, freeing their slot and blocks
    for the next iteration's admissions.
 
+The decode loop is **device-resident** (ISSUE 11). Token selection runs
+inside the jitted programs (:func:`~.kv_cache.sample_tokens` — greedy,
+temperature, top-k, top-p, per-request PRNG seed), so a decode step
+ships a ``(B,)`` token/logprob pair to the host, never ``(B, vocab)``
+logits. The per-lane inputs — next tokens, cache lengths, live masks,
+sampling state — live in a donated :class:`~.kv_cache.DecodeState` the
+decode program advances in place; the host rebuilds and re-uploads it
+only when batch **membership** changes (admit / host-side retire /
+preempt), tracked by a batch epoch, and re-uploads the block-table
+matrix only when a table actually changed. Retirement on EOS or
+``max_tokens`` is decided *on device* (the program drops the lane's
+``live`` flag), so with ``HVD_TPU_GEN_ASYNC_DEPTH=1`` the scheduler
+enqueues decode step N+1 before blocking on step N's tokens: a lane
+step N retired already routes step N+1's speculative writes to the
+null block, and the host reconciles when it drains the pipeline — it
+always drains fully before any membership change touches device state
+(``hvd_tpu_gen_step_seconds{component=host|device}`` measures the
+resulting overlap; depth 0 restores the synchronous loop).
+
 When growth hits block exhaustion the scheduler **preempts** the
 youngest block-holding sequence instead of deadlocking: its blocks are
 freed and it requeues at the *front* of the waiting line in recompute
-mode (prompt + tokens generated so far re-prefill on readmission;
-greedy decode makes the continuation deterministic). Admission bounds
-(a sequence that could never fit is rejected at submit) make the loop
-preemption-safe: the oldest sequence can always grow.
+mode (prompt + tokens generated so far re-prefill on readmission).
+Greedy decode makes the continuation deterministic, and sampled decode
+is just as deterministic: each emission's PRNG key is
+``fold_in(request seed, emitted ordinal)``, a pure function of the
+request, so the recompute replays the identical continuation. Admission
+bounds (a sequence that could never fit is rejected at submit) make the
+loop preemption-safe: the oldest sequence can always grow.
 
 Deadlines extend the PR 5 semantics **per token**: the budget
 (``HVD_TPU_GEN_DEADLINE_MS`` or the request's ``deadline_ms``) is the
@@ -40,27 +62,33 @@ rejects overload with :class:`~horovod_tpu.serving.batcher.QueueFullError`
 (HTTP 503), unchanged.
 
 Fault sites: ``serving.prefill`` (each prefill chunk — an ``error``
-fails only that sequence), ``serving.decode`` (each decode step — an
-``error`` fails only the sequences in that step's batch; waiting
-sequences are untouched and serve next), ``serving.evict`` (each
-preemption — an ``error`` fails the evicted sequence instead of
-requeueing it). See docs/robustness.md.
+fails only that sequence), ``serving.decode`` (each decode-step
+enqueue — an ``error`` fails only the sequences in that step's batch;
+an in-flight speculative step is drained first, so already-produced
+tokens are delivered and waiting sequences serve next), and
+``serving.evict`` (each preemption — an ``error`` fails the evicted
+sequence instead of requeueing it). See docs/robustness.md.
 """
 
+import collections
 import itertools
 import queue
 import threading
 import time
-from typing import Callable, List, Optional, Sequence
+from typing import Callable, List, Optional, Sequence, Tuple
 
 import numpy as np
+
+import jax.numpy as jnp
 
 from ... import _locks
 from ... import config as _config
 from ... import faults as _faults
 from ... import metrics as _metrics
+from ...models.transformer import PagedCache
 from ..batcher import DeadlineExceededError, QueueFullError
-from .kv_cache import BlockAllocator, BlocksExhaustedError
+from .kv_cache import (BlockAllocator, BlocksExhaustedError, DecodeState,
+                       SampleParams)
 
 _M_TOKENS = _metrics.counter(
     "hvd_tpu_gen_tokens_total",
@@ -90,6 +118,18 @@ _M_OCCUPANCY = _metrics.histogram(
     "padded width). Mass well below HVD_TPU_GEN_MAX_SEQS under load "
     "means admission is starved — usually by KV blocks.",
     buckets=(1, 2, 4, 8, 16, 32, 64))
+_M_STEP = _metrics.histogram(
+    "hvd_tpu_gen_step_seconds",
+    "Per scheduler iteration, the wall time split between waiting on "
+    "the device ('device': blocked in token-vector/prefill transfers) "
+    "and everything else ('host': admission, stream delivery, state "
+    "bookkeeping, enqueue). With HVD_TPU_GEN_ASYNC_DEPTH=1 the host "
+    "share overlaps the in-flight device step; a host share rivaling "
+    "the device share at depth 0 is the signal that async stepping "
+    "pays.",
+    labels=("component",),
+    buckets=(0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
+             0.25, 1.0))
 
 _FP_PREFILL = _faults.FaultPoint("serving.prefill")
 _FP_DECODE = _faults.FaultPoint("serving.decode")
@@ -106,18 +146,31 @@ _DONE = object()
 _STOP = object()
 
 
+def _seed_key(seed: int) -> np.ndarray:
+    """The (2,) uint32 threefry key for ``seed`` — identical to
+    ``jax.random.PRNGKey(seed)`` without touching the device from the
+    caller's thread."""
+    s = np.uint64(int(seed) % (1 << 64))
+    return np.array([s >> np.uint64(32), s & np.uint64(0xFFFFFFFF)],
+                    np.uint32)
+
+
 class GenSequence:
     """One generation request, submission to retirement. Also the
     caller's handle: :meth:`ContinuousBatcher.result` /
     :meth:`ContinuousBatcher.stream` consume it."""
 
     __slots__ = ("id", "prompt", "max_tokens", "eos_id", "deadline_s",
-                 "deadline", "generated", "blocks", "prefill_tokens",
-                 "prefilled", "cache_len", "next_input", "resume_decode",
-                 "state", "error", "stream_q", "done_event", "arrived_at")
+                 "deadline", "generated", "logprobs", "blocks",
+                 "prefill_tokens", "prefilled", "cache_len", "next_input",
+                 "resume_decode", "state", "error", "stream_q",
+                 "done_event", "arrived_at", "temperature", "top_k",
+                 "top_p", "seed", "key")
 
     def __init__(self, seq_id: int, prompt: List[int], max_tokens: int,
-                 eos_id: Optional[int], deadline_s: float):
+                 eos_id: Optional[int], deadline_s: float,
+                 temperature: float = 0.0, top_k: int = 0,
+                 top_p: float = 1.0, seed: Optional[int] = None):
         self.id = seq_id
         self.prompt = list(prompt)
         self.max_tokens = int(max_tokens)
@@ -125,7 +178,17 @@ class GenSequence:
         self.deadline_s = deadline_s
         self.deadline = (time.monotonic() + deadline_s
                          if deadline_s > 0 else float("inf"))
+        self.temperature = float(temperature)
+        self.top_k = int(top_k)
+        self.top_p = float(top_p)
+        #: the effective seed. Defaulting to the sequence id (assigned
+        #: at submit, never reused) keeps UNSEEDED sampled requests
+        #: deterministic across a preemption-recompute too: the replay
+        #: reuses this GenSequence, so it reuses this key.
+        self.seed = seq_id if seed is None else int(seed)
+        self.key = _seed_key(self.seed)
         self.generated: List[int] = []
+        self.logprobs: List[float] = []
         self.blocks: List[int] = []
         #: tokens whose K/V must be in the cache before decoding resumes
         #: (the prompt; after a preemption, prompt + regenerated history)
@@ -136,7 +199,7 @@ class GenSequence:
         #: the next decode step's input token (the newest generated one)
         self.next_input: Optional[int] = None
         #: True when re-prefilling after a preemption: the final chunk's
-        #: logits predict a token that was already emitted — skip it
+        #: sampled token was already emitted before eviction — skip it
         self.resume_decode = False
         self.state = "waiting"      # waiting | prefill | decode | done
         self.error: Optional[BaseException] = None
@@ -149,27 +212,34 @@ class ContinuousBatcher:
     """The generation scheduler thread plus its submission surface.
 
     Args:
-      program: the jitted paged forward from
-        :func:`~horovod_tpu.serving.generation.kv_cache.build_program`.
+      programs: the ``(prefill, decode)`` jitted program pair from
+        :func:`~.kv_cache.build_prefill_program` /
+        :func:`~.kv_cache.build_decode_program` — both sample on
+        device and return token ids + logprobs, never logits.
       params_fn: zero-arg callable returning the params to use for the
         next device call — the engine passes its hot-reload snapshot, so
         a checkpoint swap lands between steps, never inside one.
-      pools: the ``(k, v)`` pools from :func:`make_pools`.
-      allocator: the :class:`BlockAllocator` over the same pool.
+      pools: the ``(k, v)`` pools from :func:`~.kv_cache.make_pools`.
+      allocator: the :class:`~.kv_cache.BlockAllocator` over the same
+        pool.
       max_seq_len: hard cap on ``len(prompt) + max_tokens`` (the model's
         position table bounds it).
       eos_id: default EOS token id (per-request override wins; None
         means sequences run to ``max_tokens``).
+      async_depth: decode steps to keep in flight past the one being
+        consumed (defaults to ``HVD_TPU_GEN_ASYNC_DEPTH``; clamped to
+        0..1 — depth-1 reconciliation is what the loop implements).
       on_step: optional test/observability hook, called after every
         scheduler phase as ``on_step(phase, [seq_id, ...])`` with phase
         ``'prefill'`` or ``'decode'``.
 
     Knob-backed arguments (``max_seqs``, ``prefill_chunk``,
-    ``queue_depth``, ``deadline_ms``) default to their registered
-    generation knobs (docs/configuration.md).
+    ``queue_depth``, ``deadline_ms``, ``async_depth``) default to their
+    registered generation knobs (docs/configuration.md).
     """
 
-    def __init__(self, program: Callable, params_fn: Callable, pools,
+    def __init__(self, programs: Tuple[Callable, Callable],
+                 params_fn: Callable, pools,
                  allocator: BlockAllocator, max_seq_len: int,
                  max_seqs: Optional[int] = None,
                  prefill_chunk: Optional[int] = None,
@@ -177,13 +247,14 @@ class ContinuousBatcher:
                  deadline_ms: Optional[float] = None,
                  eos_id: Optional[int] = None,
                  vocab_size: Optional[int] = None,
+                 async_depth: Optional[int] = None,
                  on_step: Optional[Callable] = None):
         cfg = _config.live_config()
-        self._program = program
+        self._prefill_prog, self._decode_prog = programs
         self._params_fn = params_fn
         self._k, self._v = pools
         #: shape/dtype for rebuilding the pools after a genuine device
-        #: failure: the program donates them, so a call that dies mid-
+        #: failure: the programs donate them, so a call that dies mid-
         #: execution leaves self._k/_v pointing at deleted buffers
         self._pool_shape = tuple(self._k.shape)
         self._pool_dtype = self._k.dtype
@@ -198,6 +269,9 @@ class ContinuousBatcher:
         self.default_deadline_s = float(
             cfg.get(_config.GEN_DEADLINE_MS)
             if deadline_ms is None else deadline_ms) / 1e3
+        self.async_depth = min(1, max(0, int(
+            cfg.get(_config.GEN_ASYNC_DEPTH)
+            if async_depth is None else async_depth)))
         self.eos_id = eos_id
         self.vocab_size = vocab_size
         self.on_step = on_step
@@ -209,6 +283,20 @@ class ContinuousBatcher:
         # scheduler-thread-private state (never touched off-thread):
         self._waiting: List[GenSequence] = []
         self._running: List[GenSequence] = []
+        #: device-resident decode state; lane i of _dstate belongs to
+        #: _lanes[i] (None = free/retired lane). Rebuilt only when
+        #: _epoch (bumped on membership changes the device hasn't seen)
+        #: outruns _state_epoch.
+        self._dstate: Optional[DecodeState] = None
+        self._dtables = None
+        self._tables_dirty = True
+        self._lanes: List[Optional[GenSequence]] = [None] * self.max_seqs
+        self._epoch = 0
+        self._state_epoch = -1
+        #: decode steps enqueued but not yet consumed:
+        #: (token_dev, logprob_dev, lane snapshot)
+        self._inflight: "collections.deque" = collections.deque()
+        self._blocked_s = 0.0
         self._lock = _locks.lock(
             "serving.generation.ContinuousBatcher._lock")
         self._thread: Optional[threading.Thread] = None
@@ -218,12 +306,25 @@ class ContinuousBatcher:
 
     def submit(self, prompt: Sequence[int], max_tokens: int = 16,
                eos_id: Optional[int] = None,
-               deadline_ms: Optional[float] = None) -> GenSequence:
+               deadline_ms: Optional[float] = None,
+               temperature: Optional[float] = None,
+               top_k: Optional[int] = None,
+               top_p: Optional[float] = None,
+               seed: Optional[int] = None) -> GenSequence:
         """Admit one generation request. Raises
         :class:`~horovod_tpu.serving.batcher.QueueFullError` on a full
         queue (HTTP 503), ``ValueError`` for a request that could never
         be served (empty prompt, non-positive ``max_tokens``, a total
-        length beyond ``max_seq_len`` or beyond the whole block pool)."""
+        length beyond ``max_seq_len`` or beyond the whole block pool,
+        invalid sampling parameters).
+
+        Sampling (all on device): ``temperature`` <= 0 or None is
+        greedy; ``top_k`` > 0 and ``top_p`` < 1 restrict the sampled
+        distribution; ``seed`` pins the continuation (same seed + same
+        prompt + same params => same tokens, including across a
+        preemption-recompute). Unseeded sampled requests draw from a
+        per-request key derived from the sequence id.
+        """
         prompt = [int(t) for t in prompt]
         if not prompt:
             raise ValueError("prompt needs at least one token")
@@ -236,6 +337,18 @@ class ContinuousBatcher:
                 f"{self.vocab_size}")
         if max_tokens < 1:
             raise ValueError(f"max_tokens={max_tokens}: must be >= 1")
+        temperature = 0.0 if temperature is None else float(temperature)
+        if not 0.0 <= temperature < float("inf"):
+            raise ValueError(
+                f"temperature={temperature}: must be finite and >= 0 "
+                f"(0 = greedy)")
+        top_k = 0 if top_k is None else int(top_k)
+        if top_k < 0:
+            raise ValueError(f"top_k={top_k}: must be >= 0 (0 disables)")
+        top_p = 1.0 if top_p is None else float(top_p)
+        if not 0.0 < top_p <= 1.0:
+            raise ValueError(
+                f"top_p={top_p}: must be in (0, 1] (1 disables)")
         total = len(prompt) + int(max_tokens)
         if total > self.max_seq_len:
             raise ValueError(
@@ -257,7 +370,8 @@ class ContinuousBatcher:
                 f"budget already spent before admission")
         seq = GenSequence(next(self._ids), prompt, max_tokens,
                           self.eos_id if eos_id is None else eos_id,
-                          ddl_s)
+                          ddl_s, temperature=temperature, top_k=top_k,
+                          top_p=top_p, seed=seed)
         self._ensure_thread()
         try:
             self._q.put_nowait(seq)
@@ -277,7 +391,8 @@ class ContinuousBatcher:
                timeout: Optional[float] = None) -> List[int]:
         """Block until ``seq`` retires; return its generated tokens or
         raise its error. Composable with :meth:`stream` — this waits on
-        the retirement event, not the token queue."""
+        the retirement event, not the token queue. Per-token logprobs
+        accumulate on ``seq.logprobs``, index-aligned with the return."""
         if not seq.done_event.wait(timeout):
             raise TimeoutError("generation result not ready in time")
         if seq.error is not None:
@@ -303,10 +418,17 @@ class ContinuousBatcher:
     def generate(self, prompt: Sequence[int], max_tokens: int = 16,
                  eos_id: Optional[int] = None,
                  deadline_ms: Optional[float] = None,
+                 temperature: Optional[float] = None,
+                 top_k: Optional[int] = None,
+                 top_p: Optional[float] = None,
+                 seed: Optional[int] = None,
                  timeout: Optional[float] = None) -> List[int]:
         """submit + result in one call (the HTTP route's path)."""
-        return self.result(self.submit(prompt, max_tokens, eos_id,
-                                       deadline_ms), timeout)
+        return self.result(
+            self.submit(prompt, max_tokens, eos_id, deadline_ms,
+                        temperature=temperature, top_k=top_k, top_p=top_p,
+                        seed=seed),
+            timeout)
 
     # -- lifecycle -----------------------------------------------------------
 
@@ -360,7 +482,8 @@ class ContinuousBatcher:
         err = RuntimeError("generation scheduler stopped")
         while True:
             # block only when fully idle; otherwise drain without waiting
-            if not self._running and not self._waiting:
+            if not self._running and not self._waiting \
+                    and not self._inflight:
                 item = self._q.get()
                 if item is _STOP or self._stopped:
                     if item is not _STOP and item is not None:
@@ -379,13 +502,31 @@ class ContinuousBatcher:
             if self._stopped:
                 self._shutdown(err)
                 return
-            self._admit()
-            self._prefill_step()
-            self._decode_step()
+            # one wall clock per iteration: admission, expiry, and
+            # emission deadlines all read the same instant
+            now = time.monotonic()
+            busy = bool(self._running or self._inflight)
+            t0 = time.perf_counter()
+            self._blocked_s = 0.0
+            self._admit(now)
+            self._prefill_step(now)
+            self._decode_step(now)
+            if busy:
+                wall = time.perf_counter() - t0
+                dev = min(self._blocked_s, wall)
+                _M_STEP.labels(component="device").observe(dev)
+                _M_STEP.labels(component="host").observe(
+                    max(0.0, wall - dev))
             self._publish_gauges()
         self._shutdown(err)
 
     def _shutdown(self, err: BaseException) -> None:
+        # tokens still in flight belong to sequences this shutdown is
+        # about to fail — drop them rather than race delivery with the
+        # error
+        self._inflight.clear()
+        self._dstate = None
+        self._lanes = [None] * self.max_seqs
         for s in list(self._running) + list(self._waiting):
             self._deliver_error(s, err)
         self._running = []
@@ -398,7 +539,7 @@ class ContinuousBatcher:
 
     # -- admission -----------------------------------------------------------
 
-    def _admit(self) -> None:
+    def _admit(self, now: float) -> None:
         """FIFO admission: the head of the waiting line enters when a
         batch slot is free and the pool holds enough *free* blocks for
         its prefill. Admission never preempts (only growth of already
@@ -409,7 +550,6 @@ class ContinuousBatcher:
         anything younger runs. Expired waiters are shed wherever they
         stand (HTTP 429 shape) — a dead deadline is dead at any queue
         position."""
-        now = time.monotonic()
         for s in [x for x in self._waiting if now > x.deadline]:
             self._waiting.remove(s)
             self._deliver_error(s, DeadlineExceededError(
@@ -430,22 +570,34 @@ class ContinuousBatcher:
 
     # -- prefill -------------------------------------------------------------
 
-    def _expire_running(self) -> None:
+    def _expire_running(self, now: float) -> None:
         """The per-token contract holds for *admitted* sequences too: a
         running sequence whose budget to the next token lapsed — a slow
         multi-chunk prefill, or a decode iteration stretched past the
         budget — is shed instead of holding a batch slot and burning
-        device time for a client that already gave up."""
-        now = time.monotonic()
+        device time for a client that already gave up. Any in-flight
+        step is drained first: a token it delivers resets that
+        sequence's deadline, so only genuinely starved sequences shed."""
+        if not any(now > x.deadline for x in self._running):
+            return
+        self._flush_inflight()
         for s in [x for x in self._running if now > x.deadline]:
-            self._deliver_error(s, DeadlineExceededError(
-                f"deadline expired before sequence {s.id}'s next token"))
+            if s.state != "done":
+                self._deliver_error(s, DeadlineExceededError(
+                    f"deadline expired before sequence {s.id}'s next "
+                    f"token"))
 
-    def _prefill_step(self) -> None:
-        self._expire_running()
+    def _prefill_step(self, now: float) -> None:
+        self._expire_running(now)
         s = next((x for x in self._running if x.state == "prefill"), None)
         if s is None:
             return
+        # drain pending decode steps first: their emissions precede this
+        # prefill in device order, and the log/stream order should say so
+        # (it also makes preemption decisions below see current state)
+        self._flush_inflight()
+        if s.state != "prefill":
+            return                # a device failure during the drain
         total = len(s.prefill_tokens)
         chunk = s.prefill_tokens[s.prefilled:s.prefilled + self.prefill_chunk]
         live = len(chunk)
@@ -454,12 +606,18 @@ class ContinuousBatcher:
             return          # s itself was preempted; nothing to run
         tokens = np.zeros((1, self.prefill_chunk), np.int32)
         tokens[0, :live] = chunk
+        sample = SampleParams(
+            # the resume path discards the sampled token (it was emitted
+            # before the eviction): force the cheap greedy branch
+            temperature=jnp.asarray(
+                [0.0 if s.resume_decode else s.temperature], jnp.float32),
+            top_k=jnp.asarray([s.top_k], jnp.int32),
+            top_p=jnp.asarray([s.top_p], jnp.float32),
+            key=jnp.asarray(s.key[None, :]),
+            emitted=jnp.zeros((1,), jnp.int32))
         try:
             _FP_PREFILL.fire()
-            logits = self._run(tokens,
-                               tables=self._tables([s]),
-                               lengths=np.asarray([s.prefilled], np.int32),
-                               live=np.asarray([live], np.int32))
+            tok, logp = self._run_prefill(s, tokens, live, sample)
         except Exception as e:  # noqa: BLE001 — fails only this sequence
             self._deliver_error(s, e)
             return
@@ -468,6 +626,7 @@ class ContinuousBatcher:
         s.cache_len = s.prefilled
         if s.prefilled == total:
             s.state = "decode"
+            self._epoch += 1        # a new lane joins the decode batch
             if s.resume_decode:
                 # recompute path: the cache now holds prompt + all but
                 # the newest generated token; the next decode input is
@@ -475,85 +634,209 @@ class ContinuousBatcher:
                 s.resume_decode = False
                 s.next_input = s.generated[-1]
             else:
-                # the final chunk's last logits ARE the first generated
+                # the final chunk's sampled token IS the first generated
                 # token — a decode-phase token by accounting, even
-                # though the prefill program produced it
+                # though the prefill program produced it. (Intermediate
+                # chunks never reach this sync: their sampled token is
+                # simply not consumed.)
                 _M_TOKENS.labels(phase="decode").inc()
-                self._emit(s, int(np.argmax(logits[0, live - 1])))
+                t0 = time.perf_counter()
+                tok_v, logp_v = np.asarray(tok), np.asarray(logp)
+                self._blocked_s += time.perf_counter() - t0
+                self._emit(s, int(tok_v[0]), float(logp_v[0]), now)
         if self.on_step is not None:
             self.on_step("prefill", [s.id])
 
-    # -- decode --------------------------------------------------------------
-
-    def _decode_step(self) -> None:
-        for s in sorted([x for x in self._running if x.state == "decode"],
-                        key=lambda x: x.id):
-            if s.state != "decode":
-                continue        # preempted while growing an older peer
-            need = self._alloc.blocks_for(s.cache_len + 1) - len(s.blocks)
-            if need > 0:
-                self._grow(s, need)
-        batch = sorted([x for x in self._running if x.state == "decode"],
-                       key=lambda x: x.id)
-        if not batch:
-            return
-        B = self.max_seqs
-        tokens = np.zeros((B, DECODE_WIDTH), np.int32)
-        tables = self._tables(batch, rows=B)
-        lengths = np.zeros((B,), np.int32)
-        live = np.zeros((B,), np.int32)
-        for i, s in enumerate(batch):
-            tokens[i, 0] = s.next_input
-            lengths[i] = s.cache_len
-            live[i] = 1
+    def _run_prefill(self, s: GenSequence, tokens, live: int, sample):
+        row = np.zeros((1, self.max_blocks), np.int32)
+        row[0, :len(s.blocks)] = s.blocks
+        cache = PagedCache(self._k, self._v, jnp.asarray(row),
+                           jnp.asarray(np.asarray([s.prefilled], np.int32)),
+                           jnp.asarray(np.asarray([live], np.int32)))
         try:
-            _FP_DECODE.fire()
-            logits = self._run(tokens, tables, lengths, live)
-        except Exception as e:  # noqa: BLE001 — fails only this batch
-            for s in batch:
-                self._deliver_error(s, e)
-            return
-        _M_OCCUPANCY.observe(len(batch))
-        _M_TOKENS.labels(phase="decode").inc(len(batch))
-        for i, s in enumerate(batch):
-            s.cache_len += 1
-            self._emit(s, int(np.argmax(logits[i, 0])))
-        if self.on_step is not None:
-            self.on_step("decode", [s.id for s in batch])
-
-    # -- shared machinery ----------------------------------------------------
-
-    def _tables(self, seqs: List[GenSequence],
-                rows: Optional[int] = None) -> np.ndarray:
-        out = np.zeros((rows or len(seqs), self.max_blocks), np.int32)
-        for i, s in enumerate(seqs):
-            out[i, :len(s.blocks)] = s.blocks
-        return out
-
-    def _run(self, tokens, tables, lengths, live):
-        from ...models.transformer import PagedCache
-        import jax.numpy as jnp
-        cache = PagedCache(self._k, self._v, jnp.asarray(tables),
-                           jnp.asarray(lengths), jnp.asarray(live))
-        try:
-            logits, cache = self._program(self._params_fn(), cache,
-                                          jnp.asarray(tokens))
+            tok, logp, cache = self._prefill_prog(
+                self._params_fn(), cache, jnp.asarray(tokens), sample)
         except Exception:
             # the pools were donated into the failed call and may be
             # deleted — without recovery every later step would die on
             # invalidated buffers. Widen the blast radius to the whole
             # running set (their cache state lived in those pools) and
             # rebuild: waiting sequences still serve next iteration.
-            self._reset_pools()
+            self._reset_device()
             raise
         self._k, self._v = cache.k, cache.v
-        return np.asarray(logits)
+        return tok, logp
 
-    def _reset_pools(self) -> None:
-        import jax.numpy as jnp
+    # -- decode --------------------------------------------------------------
+
+    def _decode_step(self, now: float) -> None:
+        if not self._inflight \
+                and not any(x.state == "decode" for x in self._running):
+            return
+        # membership drifted (admit/host-retire/preempt) since the device
+        # state was built: drain the pipeline before touching it
+        if self._dstate is None or self._state_epoch != self._epoch:
+            self._flush_inflight()
+        while True:
+            batch = self._ensure_decode_blocks()
+            if batch is not None:
+                break
+        batch = [x for x in batch if x.state == "decode"]
+        if batch:
+            if self._dstate is None or self._state_epoch != self._epoch:
+                self._build_dstate(batch)
+            try:
+                _FP_DECODE.fire()
+            except Exception as e:  # noqa: BLE001 — fails only this batch
+                # the in-flight speculative step is legitimate work:
+                # deliver its tokens, then fail this step's lanes (same
+                # blast radius as the synchronous loop)
+                self._flush_inflight()
+                for s in batch:
+                    if s.state == "decode":
+                        self._deliver_error(s, e)
+                return
+            if self._tables_dirty:
+                self._upload_tables()
+            try:
+                out = self._decode_prog(self._params_fn(), self._k,
+                                        self._v, self._dtables,
+                                        self._dstate)
+            except Exception:  # noqa: BLE001
+                self._reset_device()
+                return
+            self._k, self._v, self._dstate, tok, logp = out
+            self._inflight.append((tok, logp, list(self._lanes)))
+        # consume down to the configured pipeline depth — everything,
+        # when nothing was enqueued this iteration
+        limit = self.async_depth if batch else 0
+        while len(self._inflight) > limit:
+            self._process_flight(now)
+
+    def _ensure_decode_blocks(self):
+        """Guarantee every decoding sequence owns blocks covering its
+        next write position — including the positions of steps already
+        in flight plus the one about to be enqueued. Returns the (one)
+        sorted decode list on success, or None after a flush/preemption
+        changed the projections and the caller must recompute."""
+        batch = sorted((x for x in self._running if x.state == "decode"),
+                       key=lambda x: x.id)
+        for s in batch:
+            if s.state != "decode":
+                continue    # preempted while growing an older peer
+            pending = len(self._inflight) if s in self._lanes else 0
+            need = self._alloc.blocks_for(s.cache_len + pending + 1) \
+                - len(s.blocks)
+            if need <= 0:
+                continue
+            if need <= self._alloc.free_blocks:
+                s.blocks.extend(self._alloc.allocate(need))
+                self._tables_dirty = True
+                continue
+            # exhaustion. Preemption frees blocks of lanes the device
+            # still counts live, and recompute needs exact host mirrors
+            # — both require an empty pipeline.
+            if self._inflight:
+                self._flush_inflight()
+                return None     # lengths/membership moved: re-project
+            if self._grow(s, need):
+                self._tables_dirty = True
+            return None         # membership changed either way
+        return batch
+
+    def _build_dstate(self, batch: List[GenSequence]) -> None:
+        self._flush_inflight()      # invariant, not just optimization
+        B = self.max_seqs
+        self._lanes = list(batch) + [None] * (B - len(batch))
+        tokens = np.zeros((B,), np.int32)
+        lengths = np.zeros((B,), np.int32)
+        live = np.zeros((B,), np.int32)
+        remaining = np.ones((B,), np.int32)
+        eos = np.full((B,), -1, np.int32)
+        temp = np.zeros((B,), np.float32)
+        top_k = np.zeros((B,), np.int32)
+        top_p = np.ones((B,), np.float32)
+        key = np.zeros((B, 2), np.uint32)
+        emitted = np.zeros((B,), np.int32)
+        for i, s in enumerate(batch):
+            tokens[i] = s.next_input
+            lengths[i] = s.cache_len
+            live[i] = 1
+            remaining[i] = s.max_tokens - len(s.generated)
+            eos[i] = -1 if s.eos_id is None else s.eos_id
+            temp[i] = s.temperature
+            top_k[i] = s.top_k
+            top_p[i] = s.top_p
+            key[i] = s.key
+            emitted[i] = len(s.generated)
+        self._dstate = DecodeState(
+            tokens=jnp.asarray(tokens), lengths=jnp.asarray(lengths),
+            live=jnp.asarray(live), remaining=jnp.asarray(remaining),
+            eos=jnp.asarray(eos),
+            sample=SampleParams(
+                temperature=jnp.asarray(temp), top_k=jnp.asarray(top_k),
+                top_p=jnp.asarray(top_p), key=jnp.asarray(key),
+                emitted=jnp.asarray(emitted)))
+        self._state_epoch = self._epoch
+        self._tables_dirty = True
+
+    def _upload_tables(self) -> None:
+        tables = np.zeros((self.max_seqs, self.max_blocks), np.int32)
+        for i, s in enumerate(self._lanes):
+            if s is not None and s.state == "decode":
+                tables[i, :len(s.blocks)] = s.blocks
+        self._dtables = jnp.asarray(tables)
+        self._tables_dirty = False
+
+    def _flush_inflight(self) -> None:
+        if not self._inflight:
+            return
+        now = time.monotonic()
+        while self._inflight:
+            self._process_flight(now)
+
+    def _process_flight(self, now: float) -> None:
+        tok_d, logp_d, lanes = self._inflight.popleft()
+        t0 = time.perf_counter()
+        try:
+            tok = np.asarray(tok_d)
+            logp = np.asarray(logp_d)
+        except Exception:  # noqa: BLE001 — the device step itself died
+            self._reset_device()
+            return
+        self._blocked_s += time.perf_counter() - t0
+        emitted = []
+        for i, s in enumerate(lanes):
+            # a lane retired by an earlier flight had live=0 on device
+            # for this one: no token was produced, nothing to mirror
+            if s is None or s.state != "decode":
+                continue
+            s.cache_len += 1
+            _M_TOKENS.labels(phase="decode").inc()
+            emitted.append(s.id)
+            self._emit(s, int(tok[i]), float(logp[i]), now)
+        if emitted:
+            _M_OCCUPANCY.observe(len(emitted))
+            if self.on_step is not None:
+                self.on_step("decode", emitted)
+
+    # -- shared machinery ----------------------------------------------------
+
+    def _reset_device(self) -> None:
+        """After a genuine device failure: every donated buffer (pools,
+        decode state) is suspect, so drop them all, fail the whole
+        running set, and rebuild zeroed pools — waiting sequences serve
+        next iteration."""
         err = RuntimeError(
             "generation device step failed; the paged KV pools were "
             "rebuilt and every running sequence was failed")
+        self._inflight.clear()
+        self._dstate = None
+        self._dtables = None
+        self._tables_dirty = True
+        self._state_epoch = -1
+        self._epoch += 1
+        self._lanes = [None] * self.max_seqs
         for s in list(self._running):
             self._deliver_error(s, err)
         self._k = jnp.zeros(self._pool_shape, self._pool_dtype)
@@ -563,6 +846,9 @@ class ContinuousBatcher:
         """Allocate ``need`` blocks for ``s``, preempting the youngest
         block-holding *younger* peer on exhaustion; with none left,
         ``s`` preempts itself. Returns False when ``s`` was preempted.
+        Callers guarantee the pipeline is drained before a preempting
+        grow (``_ensure_decode_blocks`` / ``_prefill_step`` flush
+        first).
 
         Only-younger matters: if a grower could evict an *older*
         sequence, two sequences could evict each other forever. This
@@ -603,29 +889,51 @@ class ContinuousBatcher:
         s.state = "waiting"
         if s in self._running:
             self._running.remove(s)
+        for i, x in enumerate(self._lanes):
+            if x is s:
+                # the device still counts this lane live: rebuild
+                # before the next enqueue
+                self._lanes[i] = None
+                self._epoch += 1
         self._waiting.insert(0, s)
         _M_PREEMPTIONS.inc()
 
-    def _emit(self, s: GenSequence, token: int) -> None:
+    def _emit(self, s: GenSequence, token: int, logprob: float,
+              now: float) -> None:
         s.generated.append(token)
+        s.logprobs.append(logprob)
         s.next_input = token
         if s.deadline_s > 0:
-            s.deadline = time.monotonic() + s.deadline_s
+            s.deadline = now + s.deadline_s
         s.stream_q.put(token)
         if (s.eos_id is not None and token == s.eos_id) \
                 or len(s.generated) >= s.max_tokens:
-            self._retire(s)
+            # the decode program applied the SAME rule on device and
+            # already dropped the lane's live flag — no epoch bump
+            self._retire(s, device_synced=True)
 
-    def _retire(self, s: GenSequence) -> None:
+    def _retire(self, s: GenSequence, device_synced: bool = True) -> None:
         if s.blocks:
             self._alloc.free(s.blocks)
             s.blocks = []
         if s in self._running:
             self._running.remove(s)
+        for i, x in enumerate(self._lanes):
+            if x is s:
+                self._lanes[i] = None
+                if not device_synced:
+                    # the device thinks the lane is live: force a state
+                    # rebuild before the next decode enqueue
+                    self._epoch += 1
         s.state = "done"
         s.stream_q.put(_DONE)
         s.done_event.set()
 
     def _deliver_error(self, s: GenSequence, err: BaseException) -> None:
+        if s.state == "done":
+            # completed (or already failed) while the error was brewing
+            # — e.g. retired by a drained in-flight step; its outcome
+            # stands
+            return
         s.error = err
-        self._retire(s)
+        self._retire(s, device_synced=False)

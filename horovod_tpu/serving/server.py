@@ -31,10 +31,14 @@ Wire formats (JSON):
   model's input; optional ``"deadline_ms"``), response
   ``{"outputs": [...], "step": N}``;
 * ``/v1/generate`` request ``{"prompt": [int, ...]}`` (optional
-  ``"max_tokens"``, ``"eos_id"``, ``"deadline_ms"``), response
-  ``{"tokens": [int, ...], "step": N}`` — ``step`` is the serving
-  checkpoint at completion (a hot-reload may land mid-sequence; decode
-  continues under the new params, see docs/inference.md).
+  ``"max_tokens"``, ``"eos_id"``, ``"deadline_ms"``, and the on-device
+  sampling controls ``"temperature"``/``"top_k"``/``"top_p"``/
+  ``"seed"`` — invalid values are a 400), response
+  ``{"tokens": [int, ...], "logprobs": [float, ...], "step": N}`` —
+  ``logprobs`` is index-aligned with ``tokens`` (the sampled token's
+  log-probability under the *unmodified* softmax), ``step`` is the
+  serving checkpoint at completion (a hot-reload may land mid-sequence;
+  decode continues under the new params, see docs/inference.md).
 """
 
 import json
@@ -139,6 +143,14 @@ class _ServingHandler(_http.QuietHandler):
             max_tokens = int(doc.get("max_tokens", 16))
             eos_id = doc.get("eos_id")
             eos_id = None if eos_id is None else int(eos_id)
+            temperature = doc.get("temperature")
+            temperature = None if temperature is None else float(temperature)
+            top_k = doc.get("top_k")
+            top_k = None if top_k is None else int(top_k)
+            top_p = doc.get("top_p")
+            top_p = None if top_p is None else float(top_p)
+            seed = doc.get("seed")
+            seed = None if seed is None else int(seed)
         except (ValueError, KeyError, TypeError) as e:
             self._respond(400, {"error": f"bad request: {e}"})
             return
@@ -148,14 +160,16 @@ class _ServingHandler(_http.QuietHandler):
         # are caught separately
         try:
             seq = gen.submit(prompt, max_tokens=max_tokens, eos_id=eos_id,
-                             deadline_ms=doc.get("deadline_ms"))
+                             deadline_ms=doc.get("deadline_ms"),
+                             temperature=temperature, top_k=top_k,
+                             top_p=top_p, seed=seed)
         except QueueFullError as e:
             self._respond(503, {"error": str(e)})
             return
         except DeadlineExceededError as e:
             self._respond(429, {"error": str(e)})
             return
-        except ValueError as e:         # could-never-fit, empty prompt
+        except ValueError as e:   # could-never-fit, bad sampling params
             self._respond(400, {"error": str(e)})
             return
         try:
@@ -167,7 +181,9 @@ class _ServingHandler(_http.QuietHandler):
             log.warning("serving: generation failed for one sequence: %s", e)
             self._respond(500, {"error": str(e)})
             return
-        self._respond(200, {"tokens": tokens, "step": gen.step})
+        self._respond(200, {"tokens": tokens,
+                            "logprobs": [round(x, 6) for x in seq.logprobs],
+                            "step": gen.step})
 
 
 class InferenceServer:

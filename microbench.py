@@ -26,7 +26,11 @@ Runs five sections, each in killable CPU subprocesses, and writes
    generation (docs/inference.md) on a mixed-length prompt workload,
    both modes driving the same compiled paged prefill/decode programs:
    useful tokens/sec and peak KV bytes (allocator high-water vs the
-   static max-length reservation).
+   static max-length reservation). Plus ``generation_sampling``: the
+   device-resident loop's on-device sampling modes (greedy vs seeded
+   temperature/top-k/top-p) under sync vs ``ASYNC_DEPTH=1`` stepping,
+   with tokens/sec and the host/device ms-per-step split from
+   ``hvd_tpu_gen_step_seconds``.
 
 Usage: ``python microbench.py [--quick]``. Workers are internal
 (``--worker-eager`` / ``--worker-scaling`` / ``--worker-injit`` /
@@ -173,13 +177,16 @@ def worker_injit(n: int, quick: bool) -> int:
 
 
 def worker_generation(quick: bool) -> int:
-    from horovod_tpu.microbench import generation_sweep
+    from horovod_tpu.microbench import generation_sweep, sampling_sweep
     row = generation_sweep(num_requests=12 if quick else 24)
+    print(MB_TAG + json.dumps(row))
+    row = sampling_sweep(num_requests=8 if quick else 16)
     print(MB_TAG + json.dumps(row))
     return 0
 
 
 def _run_generation(quick: bool, timeout: int):
+    """Returns [generation_sweep row, sampling_sweep row] (or None)."""
     p = None
     cmd = [sys.executable, os.path.abspath(__file__), "--worker-generation"]
     if quick:
@@ -195,7 +202,7 @@ def _run_generation(quick: bool, timeout: int):
         _log(f"generation: rc={p.returncode}")
         return None
     rows = _collect(p.stdout or "")
-    return rows[0] if rows else None
+    return rows or None
 
 
 def _run_injit(n: int, quick: bool, timeout: int):
@@ -288,14 +295,24 @@ def main():
                  f"(x{row['packed_speedup_vs_per_leaf']} vs per-leaf)")
     result["injit"] = injit_rows
 
-    _log("section 5/5: continuous vs static batch generation")
-    gen = _run_generation(quick, timeout=600)
+    _log("section 5/5: continuous vs static batch generation + sampling")
+    gen_rows = _run_generation(quick, timeout=900)
+    gen = gen_rows[0] if gen_rows else None
+    sampling = gen_rows[1] if gen_rows and len(gen_rows) > 1 else None
     if gen:
         _log(f"  continuous {gen['continuous']['tokens_per_s']} tok/s "
              f"(x{gen['continuous_speedup']} vs static full-batch), "
              f"peak KV {gen['kv_bytes_vs_static_reservation']} of the "
              f"static reservation")
+    if sampling:
+        ga = sampling["modes"]["greedy_async1"]
+        gs = sampling["modes"]["greedy_sync"]
+        _log(f"  sampling: greedy async1 {ga['tokens_per_s']} tok/s "
+             f"(sync {gs['tokens_per_s']}), host "
+             f"{ga['host_ms_per_step']} ms/step vs "
+             f"{gs['host_ms_per_step']} sync")
     result["generation"] = gen
+    result["generation_sampling"] = sampling
     result["wall_s"] = round(time.time() - t0, 1)
 
     out_path = os.path.join(ROOT, "MICROBENCH.json")
@@ -327,6 +344,10 @@ def main():
         if gen else None,
         "gen_speedup_vs_static_batch": gen["continuous_speedup"]
         if gen else None,
+        "gen_async1_tokens_per_s": sampling["modes"]["greedy_async1"]
+        ["tokens_per_s"] if sampling else None,
+        "gen_host_ms_per_step_async1": sampling["modes"]["greedy_async1"]
+        ["host_ms_per_step"] if sampling else None,
     }))
     return 0
 

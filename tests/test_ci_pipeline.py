@@ -153,20 +153,22 @@ def test_serving_suite_is_seeded_and_exclusive():
 
 def test_generation_suite_is_seeded_and_exclusive():
     """The continuous-batching generation suite (paged KV cache,
-    decode parity, preemption, prefill/decode/evict chaos drills) runs
-    seeded as its own CI suite; the generic unit and chaos suites must
-    not run the file twice, and the serving suite stays scoped to its
-    own file."""
+    decode parity, preemption, prefill/decode/evict chaos drills, and
+    the device-resident sampling/async loop tests) runs seeded as its
+    own CI suite; the generic unit and chaos suites must not run the
+    files twice, and the serving suite stays scoped to its own file."""
     by_name = {name: cmd for name, cmd, _t in COMMON_SUITES}
     assert "serving-gen" in by_name
     cmd = by_name["serving-gen"]
     assert "HVD_TPU_FAULT_SEED=" in cmd
-    assert "tests/test_generation.py" in cmd
-    assert "--ignore=tests/test_generation.py" in by_name["unit"]
-    assert "--ignore=tests/test_generation.py" in by_name["chaos"]
-    assert "tests/test_generation.py" not in by_name["serving"]
     root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-    assert os.path.exists(os.path.join(root, "tests", "test_generation.py"))
+    for fname in ("tests/test_generation.py",
+                  "tests/test_generation_sampling.py"):
+        assert fname in cmd
+        assert f"--ignore={fname}" in by_name["unit"]
+        assert f"--ignore={fname}" in by_name["chaos"]
+        assert fname not in by_name["serving"]
+        assert os.path.exists(os.path.join(root, *fname.split("/")))
 
 
 def test_lint_static_suite_in_every_service():
