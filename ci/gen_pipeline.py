@@ -39,13 +39,15 @@ COMMON_SUITES = [
      "--ignore=tests/test_checkpointing.py "
      "--ignore=tests/test_serving.py "
      "--ignore=tests/test_generation.py "
-     "--ignore=tests/test_generation_sampling.py", 30),
+     "--ignore=tests/test_generation_sampling.py "
+     "--ignore=tests/test_generation_prefix.py", 30),
     ("chaos", "python -m pytest tests/ -q -m chaos "
      "--ignore=tests/test_coordinator_recovery.py "
      "--ignore=tests/test_checkpointing.py "
      "--ignore=tests/test_serving.py "
      "--ignore=tests/test_generation.py "
-     "--ignore=tests/test_generation_sampling.py", 20),
+     "--ignore=tests/test_generation_sampling.py "
+     "--ignore=tests/test_generation_prefix.py", 20),
     # coordinator-kill + heartbeat-timeout drills, seeded so every run
     # replays the same fault schedule; owns its test file exclusively
     # (the generic chaos suite ignores it to avoid double runs)
@@ -67,13 +69,16 @@ COMMON_SUITES = [
      "python -m pytest tests/test_serving.py -q", 20),
     # continuous-batching generation: paged KV cache, decode/full-forward
     # parity, preemption, the seeded prefill/decode/evict chaos drills,
-    # and the device-resident loop suite (on-device sampling, seeded
-    # determinism, async stepping) — pinned seed; owns its files
+    # the device-resident loop suite (on-device sampling, seeded
+    # determinism, async stepping), and the prefix-cache suite
+    # (refcounted block sharing, cached-vs-cold bit-parity, LRU
+    # eviction-before-preemption drill) — pinned seed; owns its files
     # exclusively (unit+chaos+serving suites ignore them)
     ("serving-gen",
      "env HVD_TPU_FAULT_SEED=1234 "
      "python -m pytest tests/test_generation.py "
-     "tests/test_generation_sampling.py -q", 20),
+     "tests/test_generation_sampling.py "
+     "tests/test_generation_prefix.py -q", 20),
     ("multiproc",
      "python -m pytest tests/test_multiprocess_integration.py -q", 30),
     ("elastic", "python -m pytest tests/test_elastic_e2e.py -q", 40),

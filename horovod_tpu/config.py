@@ -512,6 +512,17 @@ GEN_ASYNC_DEPTH = _register(
          "the fully synchronous loop (debugging); values above 1 are "
          "clamped to 1 (depth-1 reconciliation is what the scheduler "
          "implements).")
+GEN_PREFIX_CACHE = _register(
+    "GEN_PREFIX_CACHE", True, _parse_bool,
+    help="Automatic prefix caching for the paged generation KV cache: "
+         "full blocks are indexed by a content chain hash, retired "
+         "blocks park in a cached-free LRU pool instead of being "
+         "recycled, and newly admitted prompts attach the longest "
+         "cached prefix with refcounts bumped so prefill starts at the "
+         "first uncached token. Sharing is full-block-only (the "
+         "partial tail block stays private), so cached-prefix decode "
+         "is bit-identical to cold decode. Set to 0 to restore the "
+         "recycle-immediately allocator.")
 
 # -- Misc -------------------------------------------------------------------
 NUM_STREAMS = _register(

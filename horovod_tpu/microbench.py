@@ -394,6 +394,35 @@ def scaling_sweep_point(batch_per_device: int = 8, image_size: int = 32,
     }
 
 
+def _gen_workload(num_requests: int, shared_prefix: int = 0):
+    """The generation sweeps' shared fixture: the tiny fp32 bench
+    transformer plus a deterministic mixed-length workload — a few long
+    generations pinned among bursts of short ones (the shape that
+    strands static batches), mixed prompt lengths including one past
+    the prefill chunk. ``shared_prefix > 0`` prepends that many
+    identical system-prompt tokens to every prompt (the
+    :func:`prefix_sweep` agentic/chat shape). Returns
+    ``(model, params, cfg, prompts, new_lens)``."""
+    import jax
+    import jax.numpy as jnp
+
+    from .models.transformer import Transformer, TransformerConfig
+
+    cfg = TransformerConfig(vocab_size=512, num_layers=4, d_model=128,
+                            num_heads=4, head_dim=32, max_seq_len=128,
+                            dtype=jnp.float32)
+    model = Transformer(cfg)
+    params = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 4), jnp.int32))
+    rng = np.random.RandomState(0)
+    system = rng.randint(0, cfg.vocab_size, (shared_prefix,)).tolist()
+    new_lens = [(32, 4, 4, 4, 8, 4, 16, 4)[i % 8]
+                for i in range(num_requests)]
+    prompts = [system + rng.randint(0, cfg.vocab_size,
+                                    (4 + (i * 5) % 20,)).tolist()
+               for i in range(num_requests)]
+    return model, params, cfg, prompts, new_lens
+
+
 def generation_sweep(num_requests: int = 24, batch_slots: int = 8,
                      block_size: int = 8) -> dict:
     """Continuous batching vs static full-batch generation on a
@@ -421,32 +450,16 @@ def generation_sweep(num_requests: int = 24, batch_slots: int = 8,
     """
     import threading
 
-    import jax
     import jax.numpy as jnp
 
-    from .models.transformer import (PagedCache, Transformer,
-                                     TransformerConfig)
+    from .models.transformer import PagedCache
     from .serving.generation import (GenerationEngine, block_bytes,
                                      build_program, make_pools)
     from .serving.generation.scheduler import DECODE_WIDTH
     from . import metrics as _metrics
 
-    cfg = TransformerConfig(vocab_size=512, num_layers=4, d_model=128,
-                            num_heads=4, head_dim=32, max_seq_len=128,
-                            dtype=jnp.float32)
-    model = Transformer(cfg)
-    rng = np.random.RandomState(0)
-    params = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 4), jnp.int32))
+    model, params, cfg, prompts, new_lens = _gen_workload(num_requests)
     prefill_chunk = 16
-
-    # mixed-length workload: a few long generations pinned among bursts
-    # of short ones (the shape that strands static batches), mixed
-    # prompt lengths including one past the prefill chunk
-    new_lens = [(32, 4, 4, 4, 8, 4, 16, 4)[i % 8]
-                for i in range(num_requests)]
-    prompts = [rng.randint(0, cfg.vocab_size,
-                           (4 + (i * 5) % 20,)).tolist()
-               for i in range(num_requests)]
     total_new = sum(new_lens)
     per_block = block_bytes(cfg, block_size)
     program = build_program(model)
@@ -565,6 +578,7 @@ def generation_sweep(num_requests: int = 24, batch_slots: int = 8,
         "num_requests": num_requests,
         "batch_slots": batch_slots,
         "block_size": block_size,
+        "num_blocks": batch_slots * max_blocks + 1,
         "model": {"layers": cfg.num_layers, "d_model": cfg.d_model,
                   "heads": cfg.num_heads, "head_dim": cfg.head_dim,
                   "vocab": cfg.vocab_size, "max_seq_len": cfg.max_seq_len},
@@ -610,24 +624,10 @@ def sampling_sweep(num_requests: int = 16, batch_slots: int = 8,
     """
     import threading
 
-    import jax
-    import jax.numpy as jnp
-
-    from .models.transformer import Transformer, TransformerConfig
     from .serving.generation import GenerationEngine
     from . import metrics as _metrics
 
-    cfg = TransformerConfig(vocab_size=512, num_layers=4, d_model=128,
-                            num_heads=4, head_dim=32, max_seq_len=128,
-                            dtype=jnp.float32)
-    model = Transformer(cfg)
-    rng = np.random.RandomState(0)
-    params = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 4), jnp.int32))
-    new_lens = [(32, 4, 4, 4, 8, 4, 16, 4)[i % 8]
-                for i in range(num_requests)]
-    prompts = [rng.randint(0, cfg.vocab_size,
-                           (4 + (i * 5) % 20,)).tolist()
-               for i in range(num_requests)]
+    model, params, cfg, prompts, new_lens = _gen_workload(num_requests)
     total_new = sum(new_lens)
     max_blocks = -(-cfg.max_seq_len // block_size)
     sampled_kw = dict(temperature=0.9, top_k=32, top_p=0.9)
@@ -695,6 +695,7 @@ def sampling_sweep(num_requests: int = 16, batch_slots: int = 8,
         "num_requests": num_requests,
         "batch_slots": batch_slots,
         "block_size": block_size,
+        "num_blocks": batch_slots * max_blocks + 1,
         "total_new_tokens": total_new,
         "sampled_params": sampled_kw,
         "modes": modes,
@@ -704,4 +705,108 @@ def sampling_sweep(num_requests: int = 16, batch_slots: int = 8,
         "async_speedup_sampled": round(
             modes["sampled_sync"]["wall_s"]
             / modes["sampled_async1"]["wall_s"], 2),
+    }
+
+
+def prefix_sweep(num_requests: int = 24, batch_slots: int = 8,
+                 block_size: int = 16) -> dict:
+    """Automatic prefix caching on a shared-system-prompt workload
+    (ISSUE 12's acceptance pair).
+
+    Every request is one 64-token shared system prompt plus a short
+    private suffix — the chat/agentic serving shape. Two engine runs
+    over the SAME compiled programs (the sampling prefill/decode
+    programs are memoized on the model): ``cache_off`` prefills every
+    prompt in full; ``cache_on`` serves request 0 alone to warm the
+    index, then the concurrent burst attaches the system prompt's
+    blocks (``hvd_tpu_gen_prefix_cache_hit_tokens_total``) and prefills
+    only its private suffix. Request 0 runs first in BOTH modes so the
+    schedules differ only in cache policy. Outputs are asserted
+    bit-identical across modes and no KV block may leak; reported per
+    mode: wall seconds, useful tokens/sec, prefilled tokens (the
+    ``hvd_tpu_gen_tokens_total{phase="prefill"}`` delta), and the
+    prefix-cache hit/miss/eviction counters.
+    """
+    import threading
+
+    from .serving.generation import GenerationEngine
+    from . import metrics as _metrics
+
+    system_tokens = 64
+    model, params, cfg, prompts, new_lens = _gen_workload(
+        num_requests, shared_prefix=system_tokens)
+    total_new = sum(new_lens)
+    max_blocks = -(-cfg.max_seq_len // block_size)
+    num_blocks = batch_slots * max_blocks + 1
+
+    def run(prefix_cache):
+        snap0 = _metrics.snapshot()
+        engine = GenerationEngine(
+            model, params=params, block_size=block_size,
+            num_blocks=num_blocks, max_seqs=batch_slots,
+            prefill_chunk=16, queue_depth=num_requests, deadline_ms=0,
+            prefix_cache=prefix_cache)
+        outs = [None] * num_requests
+        t0 = time.perf_counter()
+        # request 0 runs alone first — with the cache on it warms the
+        # index so every burst request below finds the system prompt
+        outs[0] = engine.generate(prompts[0], max_tokens=new_lens[0],
+                                  timeout=600)
+
+        def client(i):
+            outs[i] = engine.generate(prompts[i], max_tokens=new_lens[i],
+                                      timeout=600)
+
+        threads = [threading.Thread(target=client, args=(i,))
+                   for i in range(1, num_requests)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        wall = time.perf_counter() - t0
+        snap1 = _metrics.snapshot()
+        leaked = engine.allocator.in_use
+        engine.close()
+        assert leaked == 0, f"{leaked} KV blocks leaked"
+
+        def delta(key):
+            return snap1.get(key, 0) - snap0.get(key, 0)
+
+        return {
+            "wall_s": round(wall, 3),
+            "tokens_per_s": round(total_new / wall, 1),
+            "prefill_tokens": int(delta(
+                'hvd_tpu_gen_tokens_total{phase="prefill"}')),
+            "hit_tokens": int(delta(
+                "hvd_tpu_gen_prefix_cache_hit_tokens_total")),
+            "miss_tokens": int(delta(
+                "hvd_tpu_gen_prefix_cache_miss_tokens_total")),
+            "evictions": int(delta(
+                "hvd_tpu_gen_prefix_cache_evictions_total")),
+        }, outs
+
+    # compile + warm both paths off the clock (fresh engine per run, so
+    # no cache state crosses runs — only the jit caches are shared)
+    run(prefix_cache=False)
+    run(prefix_cache=True)
+    cold, cold_outs = run(prefix_cache=False)
+    warm, warm_outs = run(prefix_cache=True)
+    mismatch = sum(cold_outs[i] != warm_outs[i]
+                   for i in range(num_requests))
+    assert mismatch == 0, f"{mismatch} sequences diverged across modes"
+
+    return {
+        "scenario": "shared_prefix_generation",
+        "num_requests": num_requests,
+        "batch_slots": batch_slots,
+        "block_size": block_size,
+        "num_blocks": num_blocks,
+        "system_prompt_tokens": system_tokens,
+        "total_prompt_tokens": sum(len(p) for p in prompts),
+        "total_new_tokens": total_new,
+        "cache_off": cold,
+        "cache_on": warm,
+        "cache_speedup": round(cold["wall_s"] / warm["wall_s"], 2),
+        "prefill_reduction": round(
+            1.0 - warm["prefill_tokens"] / cold["prefill_tokens"], 3),
     }

@@ -8,9 +8,12 @@ same admission machinery:
 
 * :mod:`.kv_cache` — paged KV cache: fixed-size block pools
   (``HVD_TPU_GEN_BLOCK_SIZE`` x ``HVD_TPU_GEN_NUM_BLOCKS``), a strict
-  block allocator, and the jitted prefill/decode programs — both
-  **sample on device** (greedy/temperature/top-k/top-p, seeded per
-  request) and return ``(B,)`` token ids + logprobs, never logits;
+  refcounting block allocator with automatic prefix caching
+  (``HVD_TPU_GEN_PREFIX_CACHE``: content-indexed full blocks, a
+  cached-free LRU pool, shared prefixes across sequences), and the
+  jitted prefill/decode programs — both **sample on device**
+  (greedy/temperature/top-k/top-p, seeded per request) and return
+  ``(B,)`` token ids + logprobs, never logits;
 * :mod:`.scheduler` — :class:`ContinuousBatcher`: iteration-level
   scheduling (admit / one prefill chunk / one decode step, every step),
   immediate retirement on EOS or ``max_tokens``, preempt-and-requeue on
@@ -42,5 +45,6 @@ from .engine import GenerationEngine                        # noqa: F401
 from .kv_cache import (BlockAllocator, BlocksExhaustedError,  # noqa: F401
                        DecodeState, SampleParams, block_bytes,
                        build_decode_program, build_prefill_program,
-                       build_program, make_pools, sample_tokens)
+                       build_program, chain_hash, make_pools,
+                       sample_tokens)
 from .scheduler import ContinuousBatcher, GenSequence       # noqa: F401

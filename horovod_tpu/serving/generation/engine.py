@@ -46,13 +46,19 @@ class GenerationEngine:
       async_depth: decode steps the scheduler keeps in flight past the
         one being consumed (0 = synchronous; see
         ``HVD_TPU_GEN_ASYNC_DEPTH``).
+      prefix_cache: automatic prefix caching — full KV blocks are
+        content-indexed and shared across sequences, retired blocks
+        park in a cached-free LRU pool, and admitted prompts skip
+        prefill over their longest cached prefix (None reads
+        ``HVD_TPU_GEN_PREFIX_CACHE``, default on; cached-prefix decode
+        is bit-identical to cold decode either way).
       on_step: optional scheduler observability hook
         (``on_step(phase, [seq_id, ...])``).
 
     Knob-backed arguments (``block_size``, ``num_blocks``, ``max_seqs``,
     ``prefill_chunk``, ``queue_depth``, ``deadline_ms``,
-    ``async_depth``) default to their registered generation knobs
-    (docs/configuration.md).
+    ``async_depth``, ``prefix_cache``) default to their registered
+    generation knobs (docs/configuration.md).
     """
 
     def __init__(self, model, checkpoint_dir: Optional[str] = None,
@@ -66,6 +72,7 @@ class GenerationEngine:
                  queue_depth: Optional[int] = None,
                  deadline_ms: Optional[float] = None,
                  async_depth: Optional[int] = None,
+                 prefix_cache: Optional[bool] = None,
                  reload_poll_seconds: Optional[float] = None,
                  on_step=None):
         cfg = _config.live_config()
@@ -78,7 +85,8 @@ class GenerationEngine:
             checkpoint_dir=checkpoint_dir, params=params, sharding=sharding,
             step=step, reload_poll_seconds=reload_poll_seconds,
             plane="generation")
-        self.allocator = BlockAllocator(num_blocks, block_size)
+        self.allocator = BlockAllocator(num_blocks, block_size,
+                                        prefix_cache=prefix_cache)
         pools = make_pools(model.cfg, num_blocks, block_size)
         self.batcher = ContinuousBatcher(
             (build_prefill_program(model),
@@ -160,6 +168,11 @@ class GenerationEngine:
     @property
     def params(self):
         return self._lifecycle.params
+
+    @property
+    def prefix_cache(self) -> bool:
+        """Whether automatic prefix caching is active on this engine."""
+        return self.allocator.prefix_cache
 
     def reload(self, step: Optional[int] = None) -> bool:
         """Force a checkpoint hot-reload now (see

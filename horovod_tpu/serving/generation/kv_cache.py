@@ -24,14 +24,35 @@ caused it, instead of surfacing as silent cache corruption under load.
 ``hvd_tpu_gen_kv_blocks_in_use`` tracks the live block count;
 :attr:`BlockAllocator.peak_in_use` is the high-water mark the
 microbench compares against a dense reservation.
+
+**Automatic prefix caching** (``HVD_TPU_GEN_PREFIX_CACHE``, default
+on) adds SGLang/vLLM-style block reuse on top. Every *full* block can
+be registered under a content chain hash ``h(parent_hash,
+block_tokens)`` — the hash commits to the whole token prefix, so two
+blocks share a hash iff the cache contents feeding them were computed
+from identical prefixes. Blocks become refcounted: a prompt that
+matches a chain of indexed blocks attaches them with refcounts bumped
+(:meth:`BlockAllocator.match`) and prefill starts at the first
+uncached token. When the last reference drops, an indexed block parks
+in a **cached-free LRU pool** with contents intact instead of being
+recycled; allocation takes truly-free blocks first and only then
+evicts cached blocks, least-recently-used first. Within one release
+the blocks of a sequence are parked tail-first, so eviction consumes
+a cached chain from its tail and the head prefix stays matchable.
+Sharing is full-block-only — the partial tail block is always private
+to one sequence — so no write ever lands in a shared block and
+cached-prefix decode is bit-identical to cold decode.
 """
 
+import collections
 import dataclasses
 import functools
+import hashlib
 import math
-from typing import Any, List
+from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 from ... import _locks
+from ... import config as _config
 from ... import metrics as _metrics
 from ...models.transformer import PagedCache
 
@@ -41,6 +62,32 @@ _M_BLOCKS = _metrics.gauge(
     "(the null block excluded). Live KV memory is this times the "
     "per-block byte size; pinning near HVD_TPU_GEN_NUM_BLOCKS means "
     "admission is block-bound and preemptions are imminent.")
+_M_BLOCK_STATE = _metrics.gauge(
+    "hvd_tpu_gen_kv_blocks",
+    "KV-cache block pool split by state (the null block excluded): "
+    "free=never-written or recycled, cached=contents intact in the "
+    "prefix-cache LRU pool awaiting reuse or eviction, private=held by "
+    "exactly one live sequence, shared=prefix blocks referenced by two "
+    "or more live sequences. The four states always sum to the pool "
+    "capacity.",
+    labels=("state",))
+_M_EVICTIONS = _metrics.counter(
+    "hvd_tpu_gen_prefix_cache_evictions_total",
+    "Cached-free KV blocks whose contents were discarded to satisfy an "
+    "allocation (free list empty, LRU cached block recycled). A high "
+    "rate relative to hits means the pool is too small for the working "
+    "set of shared prefixes.")
+
+
+def chain_hash(parent: Optional[str], tokens: Sequence[int]) -> str:
+    """Content key for one full KV block: commits to the parent block's
+    hash (hence the entire token prefix) plus this block's tokens, so
+    equal hashes imply bit-equal cache contents for the whole chain."""
+    h = hashlib.sha1()
+    h.update((parent or "").encode("ascii"))
+    h.update(b"|")
+    h.update(",".join(str(int(t)) for t in tokens).encode("ascii"))
+    return h.hexdigest()
 
 
 class BlocksExhaustedError(RuntimeError):
@@ -50,9 +97,20 @@ class BlocksExhaustedError(RuntimeError):
 
 
 class BlockAllocator:
-    """Free-list allocator over the KV block pool (block 0 reserved)."""
+    """Refcounting allocator over the KV block pool (block 0 reserved).
 
-    def __init__(self, num_blocks: int, block_size: int):
+    Set-based accounting keeps every per-block operation O(1):
+    ``_free_set`` mirrors the free stack, ``_ref`` maps each live block
+    to its reference count (doubling as the owned set for double-free
+    and foreign-id rejection), and ``_cached`` is an insertion-ordered
+    dict whose order *is* the LRU eviction order of the cached-free
+    pool. ``prefix_cache=None`` reads ``HVD_TPU_GEN_PREFIX_CACHE``;
+    with the feature off, ``free`` recycles immediately and the index
+    stays empty — the PR 9 allocator, with refcounts of 1.
+    """
+
+    def __init__(self, num_blocks: int, block_size: int,
+                 prefix_cache: Optional[bool] = None):
         if num_blocks < 2:
             raise ValueError(
                 f"HVD_TPU_GEN_NUM_BLOCKS={num_blocks}: need at least 2 "
@@ -60,15 +118,30 @@ class BlockAllocator:
         if block_size < 1:
             raise ValueError(
                 f"HVD_TPU_GEN_BLOCK_SIZE={block_size}: must be >= 1")
+        if prefix_cache is None:
+            prefix_cache = bool(
+                _config.live_config().get(_config.GEN_PREFIX_CACHE))
         self.num_blocks = int(num_blocks)
         self.block_size = int(block_size)
         #: usable blocks (block 0 excluded)
         self.capacity = self.num_blocks - 1
+        self.prefix_cache = bool(prefix_cache)
         self._lock = _locks.lock("serving.generation.BlockAllocator._lock")
         # pop() hands out ascending ids — deterministic schedules make
         # the chaos drills replayable
         self._free_list = list(range(self.num_blocks - 1, 0, -1))
         self._free_set = set(self._free_list)
+        self._ref: Dict[int, int] = {}
+        # cached-free pool: block -> None, oldest-inserted first (LRU)
+        self._cached: "collections.OrderedDict[int, None]" = \
+            collections.OrderedDict()
+        self._index: Dict[str, int] = {}    # content hash -> block
+        self._hash_of: Dict[int, str] = {}  # indexed block -> its hash
+        self._n_shared = 0                  # blocks with refcount >= 2
+        #: bumped by :meth:`reset_cache`; sequences record it so a block
+        #: filled before a reset (stale params / zeroed pools) is never
+        #: registered after one
+        self.cache_gen = 0
         self.peak_in_use = 0
 
     def blocks_for(self, tokens: int) -> int:
@@ -77,49 +150,226 @@ class BlockAllocator:
 
     @property
     def free_blocks(self) -> int:
+        """Truly-free blocks (cached-free blocks not included)."""
         with self._lock:
             return len(self._free_list)
 
     @property
-    def in_use(self) -> int:
+    def cached_blocks(self) -> int:
+        """Blocks parked in the cached-free pool (refcount 0, contents
+        intact, evictable)."""
         with self._lock:
-            return self.capacity - len(self._free_list)
+            return len(self._cached)
+
+    @property
+    def available_blocks(self) -> int:
+        """Blocks an allocation could obtain right now: truly free plus
+        evictable cached. The scheduler's admissibility checks use this
+        so a prompt that fits only by evicting cached blocks is still
+        admitted."""
+        with self._lock:
+            return len(self._free_list) + len(self._cached)
+
+    @property
+    def in_use(self) -> int:
+        """Blocks referenced by at least one live sequence. Cached-free
+        blocks are *not* in use — the leak checks throughout the tests
+        and microbench rely on this returning 0 once every sequence has
+        retired, cache or no cache."""
+        with self._lock:
+            return len(self._ref)
+
+    def refcount(self, block: int) -> int:
+        """Live references to ``block`` (0 for free or cached-free)."""
+        with self._lock:
+            return self._ref.get(block, 0)
+
+    def stats(self) -> Dict[str, int]:
+        """The ``{state: count}`` pool split published on the
+        ``hvd_tpu_gen_kv_blocks`` gauge; the four states sum to
+        :attr:`capacity`."""
+        with self._lock:
+            return self._stats_locked()
+
+    def _stats_locked(self) -> Dict[str, int]:
+        return {
+            "free": len(self._free_list),
+            "cached": len(self._cached),
+            "private": len(self._ref) - self._n_shared,
+            "shared": self._n_shared,
+        }
+
+    def _publish(self, in_use: int, stats: Dict[str, int]) -> None:
+        # metric publication happens outside the lock: counts are
+        # computed under it, cells are atomic
+        _M_BLOCKS.set(in_use)
+        for state, count in stats.items():
+            _M_BLOCK_STATE.labels(state=state).set(count)
 
     def allocate(self, n: int) -> List[int]:
-        """Hand out ``n`` blocks, all-or-nothing. Raises
-        :class:`BlocksExhaustedError` when fewer than ``n`` are free."""
+        """Hand out ``n`` blocks, all-or-nothing. Truly-free blocks are
+        taken first; when the free list runs dry, cached-free blocks
+        are evicted least-recently-used first (their index entries are
+        dropped and ``hvd_tpu_gen_prefix_cache_evictions_total`` ticks).
+        Raises :class:`BlocksExhaustedError` when free + cached cannot
+        cover ``n`` — cached blocks are always sacrificed before the
+        scheduler ever considers preempting a running sequence."""
         if n <= 0:
             return []
+        evicted = 0
         with self._lock:
-            if n > len(self._free_list):
+            if n > len(self._free_list) + len(self._cached):
                 raise BlocksExhaustedError(
-                    f"need {n} KV blocks, {len(self._free_list)} free "
+                    f"need {n} KV blocks, {len(self._free_list)} free + "
+                    f"{len(self._cached)} cached "
                     f"(of {self.capacity} usable)")
-            out = [self._free_list.pop() for _ in range(n)]
-            self._free_set.difference_update(out)
-            in_use = self.capacity - len(self._free_list)
+            out = []
+            for _ in range(n):
+                if self._free_list:
+                    b = self._free_list.pop()
+                    self._free_set.discard(b)
+                else:
+                    b, _ = self._cached.popitem(last=False)
+                    h = self._hash_of.pop(b)
+                    if self._index.get(h) == b:
+                        del self._index[h]
+                    evicted += 1
+                self._ref[b] = 1
+                out.append(b)
+            in_use = len(self._ref)
             if in_use > self.peak_in_use:
                 self.peak_in_use = in_use
-        _M_BLOCKS.set(in_use)
+            stats = self._stats_locked()
+        if evicted:
+            _M_EVICTIONS.inc(evicted)
+        self._publish(in_use, stats)
         return out
 
     def free(self, blocks: List[int]) -> None:
-        """Return blocks to the pool. A double-free, the null block, or
-        an id outside the pool raises — accounting bugs must fail the
-        caller, not corrupt a stranger's cache."""
+        """Drop one reference per listed block. A block whose refcount
+        reaches 0 parks in the cached-free pool if it is indexed (the
+        sequence's blocks are parked tail-first, so LRU eviction eats a
+        chain from its tail and the head prefix stays matchable) and is
+        recycled otherwise. Releasing a free/cached block, the null
+        block, or an id outside the pool raises — accounting bugs must
+        fail the caller, not corrupt a stranger's cache."""
         with self._lock:
+            counts = collections.Counter()
             for b in blocks:
                 if not 1 <= b < self.num_blocks:
                     raise ValueError(
                         f"free of invalid KV block id {b} (pool is "
                         f"1..{self.num_blocks - 1})")
-                if b in self._free_set:
+                counts[b] += 1
+                if counts[b] > self._ref.get(b, 0):
                     raise ValueError(f"double free of KV block {b}")
+            to_park = []
             for b in blocks:
+                r = self._ref[b] - 1
+                if r == 0:
+                    del self._ref[b]
+                    h = self._hash_of.get(b)
+                    if h is not None and self._index.get(h) == b:
+                        to_park.append(b)
+                    else:
+                        if h is not None:
+                            del self._hash_of[b]
+                        self._free_list.append(b)
+                        self._free_set.add(b)
+                else:
+                    self._ref[b] = r
+                    if r == 1:
+                        self._n_shared -= 1
+            for b in reversed(to_park):
+                self._cached[b] = None
+            in_use = len(self._ref)
+            stats = self._stats_locked()
+        self._publish(in_use, stats)
+
+    # -- prefix-cache surface -----------------------------------------
+
+    def register(self, block: int, content_hash: str) -> None:
+        """Index a live *full* block under its content chain hash so
+        future prompts can match it. First registration wins: a hash
+        already indexed (or a block already hashed) is left alone, and
+        the duplicate block simply recycles on release. No-op with the
+        prefix cache off."""
+        if not self.prefix_cache:
+            return
+        with self._lock:
+            if block not in self._ref:
+                raise ValueError(
+                    f"register of KV block {block} with no live owner")
+            if content_hash not in self._index and \
+                    block not in self._hash_of:
+                self._index[content_hash] = block
+                self._hash_of[block] = content_hash
+
+    def match_probe(self, hashes: Sequence[str]) -> Tuple[int, int]:
+        """Side-effect-free length of the longest indexed prefix of
+        ``hashes``: ``(matched_blocks, matched_cached)`` where the
+        second count is how many of the matched blocks currently sit in
+        the cached-free pool (they would leave it on a real
+        :meth:`match`, so admissibility math must not double-count them
+        as evictable)."""
+        matched = cached = 0
+        with self._lock:
+            for h in hashes:
+                b = self._index.get(h)
+                if b is None:
+                    break
+                matched += 1
+                if b in self._cached:
+                    cached += 1
+        return matched, cached
+
+    def match(self, hashes: Sequence[str]) -> List[int]:
+        """Attach the longest indexed prefix of ``hashes``: cached-free
+        blocks revive with refcount 1, live blocks bump their refcount
+        (becoming shared). Returns the matched block ids in chain
+        order; the caller owns one reference to each."""
+        out: List[int] = []
+        if not self.prefix_cache:
+            return out
+        with self._lock:
+            for h in hashes:
+                b = self._index.get(h)
+                if b is None:
+                    break
+                if b in self._cached:
+                    del self._cached[b]
+                    self._ref[b] = 1
+                else:
+                    r = self._ref[b] + 1
+                    self._ref[b] = r
+                    if r == 2:
+                        self._n_shared += 1
+                out.append(b)
+            in_use = len(self._ref)
+            if in_use > self.peak_in_use:
+                self.peak_in_use = in_use
+            stats = self._stats_locked()
+        if out:
+            self._publish(in_use, stats)
+        return out
+
+    def reset_cache(self) -> None:
+        """Drop the whole content index and recycle every cached-free
+        block. Called when cache *contents* stop being trustworthy —
+        a params hot-swap or a device-pool rebuild — and bumps
+        :attr:`cache_gen` so blocks filled under the old contents are
+        never registered under the new ones."""
+        with self._lock:
+            for b in self._cached:
                 self._free_list.append(b)
                 self._free_set.add(b)
-            in_use = self.capacity - len(self._free_list)
-        _M_BLOCKS.set(in_use)
+            self._cached.clear()
+            self._index.clear()
+            self._hash_of.clear()
+            self.cache_gen += 1
+            in_use = len(self._ref)
+            stats = self._stats_locked()
+        self._publish(in_use, stats)
 
 
 def make_pools(model_cfg, num_blocks: int, block_size: int):

@@ -61,6 +61,30 @@ mid-stream. The bounded submit queue (``HVD_TPU_GEN_QUEUE_DEPTH``)
 rejects overload with :class:`~horovod_tpu.serving.batcher.QueueFullError`
 (HTTP 503), unchanged.
 
+**Prefix caching** (``HVD_TPU_GEN_PREFIX_CACHE``, default on) makes
+admission content-aware: each prompt's full blocks are chain-hashed
+(:func:`~.kv_cache.chain_hash`) and matched against the allocator's
+content index, the longest cached prefix is attached to the new block
+table with refcounts bumped, and chunked prefill starts at the first
+uncached token (``hvd_tpu_gen_prefix_cache_hit_tokens_total`` /
+``_miss_tokens_total`` split every admission). Matching is full-block
+-only and capped below the last prompt token, so prefill always has at
+least one token to run — the prefill program is what samples the first
+generated token — and the partial tail block stays private: decode
+never writes into a shared block, which is why cached-prefix decode is
+bit-identical to cold decode. Retirement and preemption are refcount
+decrements (full blocks park in the allocator's cached-free pool), and
+preemption-recompute re-matches the cache so a preempted sequence's
+resume prefill is nearly free while its cached chain survives.
+Admissibility is cache-aware — a prompt that fits only by evicting
+cached blocks is admissible, because ``allocate`` always evicts cached
+blocks before the scheduler would consider preempting anyone — and
+with a cold cache the check degrades to exactly the PR 9 free-blocks
+rule. Refcount mutations obey the PR 11 flush rules: they happen on
+the scheduler thread inside the same admit/retire/preempt paths whose
+membership changes already drain the in-flight pipeline first, so
+speculation never observes a half-updated block table.
+
 Fault sites: ``serving.prefill`` (each prefill chunk — an ``error``
 fails only that sequence), ``serving.decode`` (each decode-step
 enqueue — an ``error`` fails only the sequences in that step's batch;
@@ -88,7 +112,7 @@ from ... import metrics as _metrics
 from ...models.transformer import PagedCache
 from ..batcher import DeadlineExceededError, QueueFullError
 from .kv_cache import (BlockAllocator, BlocksExhaustedError, DecodeState,
-                       SampleParams)
+                       SampleParams, chain_hash)
 
 _M_TOKENS = _metrics.counter(
     "hvd_tpu_gen_tokens_total",
@@ -106,6 +130,17 @@ _M_WAITING = _metrics.gauge(
     "hvd_tpu_gen_waiting_seqs",
     "Sequences admitted to the bounded queue but not yet running "
     "(including preempted sequences awaiting re-prefill).")
+_M_PREFIX_HIT = _metrics.counter(
+    "hvd_tpu_gen_prefix_cache_hit_tokens_total",
+    "Prompt tokens whose KV was served from the prefix cache at "
+    "admission (full cached blocks attached to the sequence's table "
+    "instead of being prefilled). Re-admissions after a preemption "
+    "count again, mirroring hvd_tpu_gen_tokens_total{phase='prefill'}.")
+_M_PREFIX_MISS = _metrics.counter(
+    "hvd_tpu_gen_prefix_cache_miss_tokens_total",
+    "Prompt tokens the prefix cache could not serve at admission — "
+    "they go through chunked prefill. hit/(hit+miss) is the cache's "
+    "token hit rate; only emitted with HVD_TPU_GEN_PREFIX_CACHE on.")
 _M_PREEMPTIONS = _metrics.counter(
     "hvd_tpu_gen_preemptions_total",
     "Sequences preempted on KV-block exhaustion: blocks freed, sequence "
@@ -144,6 +179,7 @@ DECODE_WIDTH = 2
 
 _DONE = object()
 _STOP = object()
+_UNSET = object()
 
 
 def _seed_key(seed: int) -> np.ndarray:
@@ -165,7 +201,8 @@ class GenSequence:
                  "prefill_tokens", "prefilled", "cache_len", "next_input",
                  "resume_decode", "state", "error", "stream_q",
                  "done_event", "arrived_at", "temperature", "top_k",
-                 "top_p", "seed", "key")
+                 "top_p", "seed", "key", "prefix_hashes", "block_hashes",
+                 "cache_gen")
 
     def __init__(self, seq_id: int, prompt: List[int], max_tokens: int,
                  eos_id: Optional[int], deadline_s: float,
@@ -201,6 +238,18 @@ class GenSequence:
         #: True when re-prefilling after a preemption: the final chunk's
         #: sampled token was already emitted before eviction — skip it
         self.resume_decode = False
+        #: content chain hashes of prefill_tokens' matchable full blocks
+        #: (capped below the last token), recomputed when prefill_tokens
+        #: changes; the admission match consumes a prefix of this
+        self.prefix_hashes: List[str] = []
+        #: chain hashes of this sequence's *filled* full blocks —
+        #: block_hashes[j] describes blocks[j]; grows as cache_len
+        #: crosses block boundaries
+        self.block_hashes: List[str] = []
+        #: allocator cache generation the blocks were filled under; a
+        #: mismatch (params swap / device reset since) vetoes
+        #: registration of stale contents
+        self.cache_gen = -1
         self.state = "waiting"      # waiting | prefill | decode | done
         self.error: Optional[BaseException] = None
         self.stream_q: "queue.Queue" = queue.Queue()
@@ -259,6 +308,11 @@ class ContinuousBatcher:
         self._pool_shape = tuple(self._k.shape)
         self._pool_dtype = self._k.dtype
         self._alloc = allocator
+        self._prefix_cache = bool(getattr(allocator, "prefix_cache", False))
+        #: identity of the params object the last device call used —
+        #: a hot-swap means cached K/V no longer matches what a cold
+        #: prefill would compute, so the prefix cache resets on change
+        self._last_params = _UNSET
         self.max_seq_len = int(max_seq_len)
         self.max_seqs = int(cfg.get(_config.GEN_MAX_SEQS)
                             if max_seqs is None else max_seqs)
@@ -355,6 +409,10 @@ class ContinuousBatcher:
                 f"len(prompt) + max_tokens = {total} exceeds "
                 f"max_seq_len={self.max_seq_len}")
         if self._alloc.blocks_for(total) > self._alloc.capacity:
+            # cache-independent bound: within ONE block table every
+            # entry is a distinct pool block even when shared with
+            # other sequences, so a table wider than the pool can never
+            # materialize — no amount of prefix caching changes that
             raise ValueError(
                 f"request needs {self._alloc.blocks_for(total)} KV "
                 f"blocks, more than the whole pool "
@@ -372,6 +430,11 @@ class ContinuousBatcher:
                           self.eos_id if eos_id is None else eos_id,
                           ddl_s, temperature=temperature, top_k=top_k,
                           top_p=top_p, seed=seed)
+        if self._prefix_cache:
+            # hashed on the submitter's thread (pure computation on a
+            # sequence the scheduler can't see yet) so the hot loop
+            # only pays for the index probe
+            seq.prefix_hashes = self._prefix_hashes_for(seq.prefill_tokens)
         self._ensure_thread()
         try:
             self._q.put_nowait(seq)
@@ -505,6 +568,12 @@ class ContinuousBatcher:
             # one wall clock per iteration: admission, expiry, and
             # emission deadlines all read the same instant
             now = time.monotonic()
+            if self._prefix_cache:
+                # notice a params hot-swap BEFORE admission: matching
+                # must never attach blocks computed under the previous
+                # checkpoint (the device calls below would re-check, but
+                # only after this iteration's match already committed)
+                self._params()
             busy = bool(self._running or self._inflight)
             t0 = time.perf_counter()
             self._blocked_s = 0.0
@@ -541,15 +610,25 @@ class ContinuousBatcher:
 
     def _admit(self, now: float) -> None:
         """FIFO admission: the head of the waiting line enters when a
-        batch slot is free and the pool holds enough *free* blocks for
-        its prefill. Admission never preempts (only growth of already
-        -running sequences does) — an arrival that could steal blocks
-        from the sequence that just preempted FOR it would ping-pong
-        the pool forever. No head-of-line skipping either: a preempted
-        sequence parked at the front must regain its blocks before
-        anything younger runs. Expired waiters are shed wherever they
-        stand (HTTP 429 shape) — a dead deadline is dead at any queue
-        position."""
+        batch slot is free and the pool can cover its prefill.
+        Admission never preempts (only growth of already-running
+        sequences does) — an arrival that could steal blocks from the
+        sequence that just preempted FOR it would ping-pong the pool
+        forever. No head-of-line skipping either: a preempted sequence
+        parked at the front must regain its blocks before anything
+        younger runs. Expired waiters are shed wherever they stand
+        (HTTP 429 shape) — a dead deadline is dead at any queue
+        position.
+
+        The block check is cache-aware: matched prefix blocks need no
+        allocation, and the remainder may come from truly-free blocks
+        or by evicting cached-free blocks that are NOT part of the
+        match. With a cold (or disabled) cache nothing matches and
+        nothing is cached, so the gate degrades to the conservative
+        PR 9 rule — enough *free* blocks for the whole prefill. The
+        gate is per-sequence instantaneous state, not a reservation;
+        the prefill/decode growth path still backstops any shortfall
+        with preemption, exactly as before."""
         for s in [x for x in self._waiting if now > x.deadline]:
             self._waiting.remove(s)
             self._deliver_error(s, DeadlineExceededError(
@@ -559,13 +638,30 @@ class ContinuousBatcher:
             s = self._waiting[0]
             if len(self._running) >= self.max_seqs:
                 break
-            if self._alloc.blocks_for(len(s.prefill_tokens) + 1) \
-                    > self._alloc.free_blocks:
+            need_total = self._alloc.blocks_for(len(s.prefill_tokens) + 1)
+            matched = matched_cached = 0
+            if self._prefix_cache and s.prefix_hashes:
+                matched, matched_cached = \
+                    self._alloc.match_probe(s.prefix_hashes)
+            # matched cached blocks leave the evictable pool the moment
+            # they attach, so they must not double-count as evictable
+            evictable = self._alloc.cached_blocks - matched_cached
+            if need_total - matched > self._alloc.free_blocks + evictable:
                 break
             self._waiting.pop(0)
             s.state = "prefill"
             s.prefilled = 0
             s.cache_len = 0
+            s.blocks = []
+            s.block_hashes = []
+            if self._prefix_cache:
+                s.blocks = self._alloc.match(s.prefix_hashes)
+                s.block_hashes = list(s.prefix_hashes[:len(s.blocks)])
+                s.prefilled = len(s.blocks) * self._alloc.block_size
+                s.cache_len = s.prefilled
+                _M_PREFIX_HIT.inc(s.prefilled)
+                _M_PREFIX_MISS.inc(len(s.prefill_tokens) - s.prefilled)
+            s.cache_gen = self._alloc.cache_gen
             self._running.append(s)
 
     # -- prefill -------------------------------------------------------------
@@ -624,6 +720,7 @@ class ContinuousBatcher:
         _M_TOKENS.labels(phase="prefill").inc(live)
         s.prefilled += live
         s.cache_len = s.prefilled
+        self._register_full_blocks(s)
         if s.prefilled == total:
             s.state = "decode"
             self._epoch += 1        # a new lane joins the decode batch
@@ -655,7 +752,7 @@ class ContinuousBatcher:
                            jnp.asarray(np.asarray([live], np.int32)))
         try:
             tok, logp, cache = self._prefill_prog(
-                self._params_fn(), cache, jnp.asarray(tokens), sample)
+                self._params(), cache, jnp.asarray(tokens), sample)
         except Exception:
             # the pools were donated into the failed call and may be
             # deleted — without recovery every later step would die on
@@ -699,7 +796,7 @@ class ContinuousBatcher:
             if self._tables_dirty:
                 self._upload_tables()
             try:
-                out = self._decode_prog(self._params_fn(), self._k,
+                out = self._decode_prog(self._params(), self._k,
                                         self._v, self._dtables,
                                         self._dstate)
             except Exception:  # noqa: BLE001
@@ -729,7 +826,9 @@ class ContinuousBatcher:
                 - len(s.blocks)
             if need <= 0:
                 continue
-            if need <= self._alloc.free_blocks:
+            # available counts evictable cached blocks too: allocate
+            # sacrifices those before the scheduler considers preempting
+            if need <= self._alloc.available_blocks:
                 s.blocks.extend(self._alloc.allocate(need))
                 self._tables_dirty = True
                 continue
@@ -812,6 +911,10 @@ class ContinuousBatcher:
             if s is None or s.state != "decode":
                 continue
             s.cache_len += 1
+            if s.cache_len % self._alloc.block_size == 0:
+                # this write completed a block: index it so multi-turn
+                # prompts can reuse generated history too
+                self._register_full_blocks(s)
             _M_TOKENS.labels(phase="decode").inc()
             emitted.append(s.id)
             self._emit(s, int(tok[i]), float(logp[i]), now)
@@ -821,6 +924,55 @@ class ContinuousBatcher:
                 self.on_step("decode", emitted)
 
     # -- shared machinery ----------------------------------------------------
+
+    def _params(self):
+        """The params for the next device call, watching for hot-swaps:
+        cached K/V was computed under the *previous* checkpoint, so a
+        new params object drops the whole prefix-cache index (live
+        sequences keep decoding on their own blocks, per the PR 5
+        hot-reload doctrine — only cross-sequence reuse is severed)."""
+        p = self._params_fn()
+        if p is not self._last_params:
+            if self._last_params is not _UNSET and self._prefix_cache:
+                self._alloc.reset_cache()
+            self._last_params = p
+        return p
+
+    def _prefix_hashes_for(self, tokens: List[int]) -> List[str]:
+        """Chain hashes of ``tokens``' matchable full blocks, capped
+        below the final token: prefill must always have at least one
+        token to run, because the prefill program is what samples the
+        first generated token."""
+        bs = self._alloc.block_size
+        n = max(0, (len(tokens) - 1) // bs)
+        out: List[str] = []
+        parent: Optional[str] = None
+        for j in range(n):
+            parent = chain_hash(parent, tokens[j * bs:(j + 1) * bs])
+            out.append(parent)
+        return out
+
+    def _register_full_blocks(self, s: GenSequence) -> None:
+        """Index every newly *completed* block of ``s`` under its
+        content chain hash. Skipped when the allocator's cache
+        generation moved since admission — the blocks were filled under
+        contents (params / pools) that no longer exist."""
+        if not self._prefix_cache or s.cache_gen != self._alloc.cache_gen:
+            return
+        bs = self._alloc.block_size
+        target = s.cache_len // bs
+        if target <= len(s.block_hashes):
+            return
+        full = s.prompt + s.generated
+        while len(s.block_hashes) < target:
+            j = len(s.block_hashes)
+            if j < len(s.prefix_hashes):
+                h = s.prefix_hashes[j]
+            else:
+                h = chain_hash(s.block_hashes[-1] if j else None,
+                               full[j * bs:(j + 1) * bs])
+            self._alloc.register(s.blocks[j], h)
+            s.block_hashes.append(h)
 
     def _reset_device(self) -> None:
         """After a genuine device failure: every donated buffer (pools,
@@ -841,6 +993,9 @@ class ContinuousBatcher:
             self._deliver_error(s, err)
         self._k = jnp.zeros(self._pool_shape, self._pool_dtype)
         self._v = jnp.zeros(self._pool_shape, self._pool_dtype)
+        # the rebuilt pools are zeroed: every indexed block's contents
+        # are gone, so the content index must go with them
+        self._alloc.reset_cache()
 
     def _grow(self, s: GenSequence, need: int) -> bool:
         """Allocate ``need`` blocks for ``s``, preempting the youngest
@@ -879,11 +1034,17 @@ class ContinuousBatcher:
             return
         self._alloc.free(s.blocks)
         s.blocks = []
+        s.block_hashes = []
         if s.state == "decode" and s.generated:
             # cache must be rebuilt up to (but not including) the newest
             # generated token — it is the resumed decode's input
             s.prefill_tokens = s.prompt + s.generated[:-1]
             s.resume_decode = True
+            if self._prefix_cache:
+                # re-match on readmission: the full blocks just freed
+                # parked in the cached pool, so unless pressure evicts
+                # them first the resume prefill is nearly free
+                s.prefix_hashes = self._prefix_hashes_for(s.prefill_tokens)
         s.prefilled = 0
         s.cache_len = 0
         s.state = "waiting"

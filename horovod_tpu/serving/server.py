@@ -85,6 +85,14 @@ class _ServingHandler(_http.QuietHandler):
         doc = {"status": "serving", "step": engine.step}
         if self.server.engine is not None:
             doc["queue_depth"] = self.server.engine.queue_depth
+        if self.server.gen_engine is not None:
+            # the generation plane's capacity story: prefix-cache mode
+            # plus the block pool split (free/cached/private/shared sums
+            # to the pool capacity) — the same numbers the
+            # hvd_tpu_gen_kv_blocks{state} gauge publishes
+            alloc = self.server.gen_engine.allocator
+            doc["prefix_cache"] = bool(alloc.prefix_cache)
+            doc["kv_blocks"] = alloc.stats()
         self._respond(200, doc)
 
     def do_POST(self):  # noqa: N802

@@ -30,7 +30,11 @@ Runs five sections, each in killable CPU subprocesses, and writes
    device-resident loop's on-device sampling modes (greedy vs seeded
    temperature/top-k/top-p) under sync vs ``ASYNC_DEPTH=1`` stepping,
    with tokens/sec and the host/device ms-per-step split from
-   ``hvd_tpu_gen_step_seconds``.
+   ``hvd_tpu_gen_step_seconds``. Plus ``generation_prefix``: automatic
+   prefix caching on a shared-64-token-system-prompt workload, cache
+   on vs off over the same compiled programs (outputs asserted
+   identical), reporting tokens/sec, prefilled tokens, and the cache
+   hit/miss/eviction counters.
 
 Usage: ``python microbench.py [--quick]``. Workers are internal
 (``--worker-eager`` / ``--worker-scaling`` / ``--worker-injit`` /
@@ -177,16 +181,20 @@ def worker_injit(n: int, quick: bool) -> int:
 
 
 def worker_generation(quick: bool) -> int:
-    from horovod_tpu.microbench import generation_sweep, sampling_sweep
+    from horovod_tpu.microbench import (generation_sweep, prefix_sweep,
+                                        sampling_sweep)
     row = generation_sweep(num_requests=12 if quick else 24)
     print(MB_TAG + json.dumps(row))
     row = sampling_sweep(num_requests=8 if quick else 16)
+    print(MB_TAG + json.dumps(row))
+    row = prefix_sweep(num_requests=12 if quick else 24)
     print(MB_TAG + json.dumps(row))
     return 0
 
 
 def _run_generation(quick: bool, timeout: int):
-    """Returns [generation_sweep row, sampling_sweep row] (or None)."""
+    """Returns [generation_sweep, sampling_sweep, prefix_sweep] rows
+    (or None)."""
     p = None
     cmd = [sys.executable, os.path.abspath(__file__), "--worker-generation"]
     if quick:
@@ -296,9 +304,10 @@ def main():
     result["injit"] = injit_rows
 
     _log("section 5/5: continuous vs static batch generation + sampling")
-    gen_rows = _run_generation(quick, timeout=900)
+    gen_rows = _run_generation(quick, timeout=1200)
     gen = gen_rows[0] if gen_rows else None
     sampling = gen_rows[1] if gen_rows and len(gen_rows) > 1 else None
+    prefix = gen_rows[2] if gen_rows and len(gen_rows) > 2 else None
     if gen:
         _log(f"  continuous {gen['continuous']['tokens_per_s']} tok/s "
              f"(x{gen['continuous_speedup']} vs static full-batch), "
@@ -311,8 +320,14 @@ def main():
              f"(sync {gs['tokens_per_s']}), host "
              f"{ga['host_ms_per_step']} ms/step vs "
              f"{gs['host_ms_per_step']} sync")
+    if prefix:
+        _log(f"  prefix cache: {prefix['cache_on']['tokens_per_s']} tok/s "
+             f"on vs {prefix['cache_off']['tokens_per_s']} off "
+             f"(x{prefix['cache_speedup']}), prefill reduced "
+             f"{prefix['prefill_reduction']:.0%}")
     result["generation"] = gen
     result["generation_sampling"] = sampling
+    result["generation_prefix"] = prefix
     result["wall_s"] = round(time.time() - t0, 1)
 
     out_path = os.path.join(ROOT, "MICROBENCH.json")
@@ -348,6 +363,10 @@ def main():
         ["tokens_per_s"] if sampling else None,
         "gen_host_ms_per_step_async1": sampling["modes"]["greedy_async1"]
         ["host_ms_per_step"] if sampling else None,
+        "gen_prefix_cache_speedup": prefix["cache_speedup"]
+        if prefix else None,
+        "gen_prefix_prefill_reduction": prefix["prefill_reduction"]
+        if prefix else None,
     }))
     return 0
 

@@ -153,17 +153,19 @@ def test_serving_suite_is_seeded_and_exclusive():
 
 def test_generation_suite_is_seeded_and_exclusive():
     """The continuous-batching generation suite (paged KV cache,
-    decode parity, preemption, prefill/decode/evict chaos drills, and
-    the device-resident sampling/async loop tests) runs seeded as its
-    own CI suite; the generic unit and chaos suites must not run the
-    files twice, and the serving suite stays scoped to its own file."""
+    decode parity, preemption, prefill/decode/evict chaos drills, the
+    device-resident sampling/async loop tests, and the prefix-cache
+    suite) runs seeded as its own CI suite; the generic unit and chaos
+    suites must not run the files twice, and the serving suite stays
+    scoped to its own file."""
     by_name = {name: cmd for name, cmd, _t in COMMON_SUITES}
     assert "serving-gen" in by_name
     cmd = by_name["serving-gen"]
     assert "HVD_TPU_FAULT_SEED=" in cmd
     root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     for fname in ("tests/test_generation.py",
-                  "tests/test_generation_sampling.py"):
+                  "tests/test_generation_sampling.py",
+                  "tests/test_generation_prefix.py"):
         assert fname in cmd
         assert f"--ignore={fname}" in by_name["unit"]
         assert f"--ignore={fname}" in by_name["chaos"]

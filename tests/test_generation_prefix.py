@@ -1,0 +1,533 @@
+"""Prefix-cached paged KV suite (ISSUE 12): refcounted block sharing,
+the cached-free LRU pool, zero-prefill admission for shared prompts,
+and the cached-vs-cold bit-parity pins.
+
+Run as part of the seeded ``serving-gen`` CI suite (ci/gen_pipeline.py
+owns this file exclusively; unit/chaos ignore it). Everything is
+in-process on the CPU mesh with the same tiny fp32 transformer as
+tests/test_generation.py, so the memoized prefill/decode programs are
+shared across the generation suites — cache-on and cache-off engines
+run the *identical* compiled programs, which is what makes the
+bit-parity assertions meaningful.
+"""
+
+import collections
+import json
+import threading
+from urllib.request import urlopen
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from horovod_tpu import faults as F
+from horovod_tpu import metrics as M
+from horovod_tpu import serving
+from horovod_tpu.models.transformer import Transformer, TransformerConfig
+from horovod_tpu.serving.generation import (BlockAllocator,
+                                            BlocksExhaustedError,
+                                            GenerationEngine, chain_hash)
+
+SEED = 1234
+
+CFG = TransformerConfig(vocab_size=64, num_layers=2, d_model=32,
+                        num_heads=2, head_dim=16, max_seq_len=64,
+                        dtype=jnp.float32)
+
+HIT = "hvd_tpu_gen_prefix_cache_hit_tokens_total"
+MISS = "hvd_tpu_gen_prefix_cache_miss_tokens_total"
+EVICTIONS = "hvd_tpu_gen_prefix_cache_evictions_total"
+PREFILL = 'hvd_tpu_gen_tokens_total{phase="prefill"}'
+PREEMPTIONS = "hvd_tpu_gen_preemptions_total"
+
+
+@pytest.fixture(autouse=True)
+def _reset_faults():
+    yield
+    F.configure("", seed=0)
+
+
+@pytest.fixture(scope="module")
+def model_params():
+    model = Transformer(CFG)
+    params = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 4), jnp.int32))
+    ref = jax.jit(model.apply)
+    return model, params, ref
+
+
+def _greedy_reference(ref, params, prompt, n):
+    seq = list(prompt)
+    for _ in range(n):
+        logits = np.asarray(ref(params, jnp.asarray([seq], jnp.int32)))
+        seq.append(int(np.argmax(logits[0, -1])))
+    return seq[len(prompt):]
+
+
+def _engine(model, params, **kw):
+    kw.setdefault("block_size", 4)
+    kw.setdefault("num_blocks", 33)
+    kw.setdefault("max_seqs", 4)
+    kw.setdefault("prefill_chunk", 8)
+    kw.setdefault("deadline_ms", 0)
+    return GenerationEngine(model, params=params, **kw)
+
+
+def _prompt(rng, n):
+    return rng.randint(0, CFG.vocab_size, (n,)).tolist()
+
+
+def _delta(before, key):
+    return M.snapshot().get(key, 0) - before.get(key, 0)
+
+
+def _hashes(tokens, block_size):
+    """Chain hashes of every full block of ``tokens``."""
+    out, parent = [], None
+    for j in range(len(tokens) // block_size):
+        parent = chain_hash(parent,
+                            tokens[j * block_size:(j + 1) * block_size])
+        out.append(parent)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# allocator: refcounts, content index, cached-free LRU pool
+# ---------------------------------------------------------------------------
+
+class TestAllocatorPrefixCache:
+    def test_register_match_share_release_revive(self):
+        a = BlockAllocator(num_blocks=9, block_size=4, prefix_cache=True)
+        hs = _hashes(list(range(8)), 4)
+        b = a.allocate(2)
+        a.register(b[0], hs[0])
+        a.register(b[1], hs[1])
+        assert a.match_probe(hs) == (2, 0)
+        # a second owner attaches the live chain: refcounts bump, shared
+        assert a.match(hs) == b
+        assert a.refcount(b[0]) == 2 and a.refcount(b[1]) == 2
+        assert a.stats()["shared"] == 2 and a.in_use == 2
+        a.free(b)                    # first owner out: private again
+        assert a.refcount(b[0]) == 1 and a.stats()["shared"] == 0
+        a.free(b)                    # last reference: parked, not freed
+        assert a.in_use == 0
+        assert a.cached_blocks == 2 and a.free_blocks == 6
+        assert a.available_blocks == 8
+        assert a.match_probe(hs) == (2, 2)
+        # revive from the cached pool with refcount 1
+        assert a.match(hs) == b and a.cached_blocks == 0
+        assert a.refcount(b[0]) == 1
+        a.free(b)
+
+    def test_partial_chain_match_stops_at_first_miss(self):
+        a = BlockAllocator(num_blocks=9, block_size=4, prefix_cache=True)
+        toks = list(range(12))
+        hs = _hashes(toks, 4)
+        b = a.allocate(2)
+        a.register(b[0], hs[0])      # only the head is indexed
+        assert a.match_probe(hs) == (1, 0)
+        got = a.match(hs)
+        assert got == [b[0]]
+        a.free(b)
+        a.free(got)
+
+    def test_lru_eviction_is_tail_first_and_counts(self):
+        a = BlockAllocator(num_blocks=5, block_size=2, prefix_cache=True)
+        toks = list(range(8))
+        hs = _hashes(toks, 2)
+        b = a.allocate(4)
+        for blk, h in zip(b, hs):
+            a.register(blk, h)
+        a.free(b)
+        assert a.cached_blocks == 4 and a.free_blocks == 0
+        before = M.snapshot()
+        # allocation pressure evicts the LRU cached block — the chain's
+        # TAIL (blocks park tail-first), so the head prefix survives
+        got = a.allocate(1)
+        assert got == [b[3]]
+        assert _delta(before, EVICTIONS) == 1
+        assert a.match_probe(hs) == (3, 3)
+        a.free(got)                  # hash evicted with it: truly free
+        assert a.free_blocks == 1 and a.cached_blocks == 3
+
+    def test_eviction_never_touches_referenced_blocks(self):
+        a = BlockAllocator(num_blocks=5, block_size=2, prefix_cache=True)
+        live = a.allocate(2)
+        a.register(live[0], chain_hash(None, [1, 2]))
+        done = a.allocate(2)
+        a.register(done[0], chain_hash(None, [3, 4]))
+        a.register(done[1], chain_hash(None, [5, 6]))
+        a.free(done)                 # free 0, cached 2, refcounted 2
+        got = a.allocate(2)          # must come from the cached pool only
+        assert set(got) == set(done)
+        with pytest.raises(BlocksExhaustedError):
+            a.allocate(1)
+        assert a.refcount(live[0]) == 1 and a.refcount(live[1]) == 1
+        a.free(got)
+        a.free(live)
+
+    def test_double_free_foreign_ids_and_over_release(self):
+        a = BlockAllocator(num_blocks=9, block_size=4, prefix_cache=True)
+        b = a.allocate(1)
+        h = chain_hash(None, [5, 6, 7, 8])
+        a.register(b[0], h)
+        assert a.match([h]) == b     # refcount 2
+        a.free(b)
+        a.free(b)                    # both owners released: parked
+        with pytest.raises(ValueError, match="double free"):
+            a.free(b)                # cached is NOT yours to release
+        with pytest.raises(ValueError, match="invalid"):
+            a.free([0])
+        with pytest.raises(ValueError, match="invalid"):
+            a.free([99])
+        c = a.allocate(1)
+        # over-release within one call is rejected before any mutation
+        with pytest.raises(ValueError, match="double free"):
+            a.free([c[0], c[0]])
+        assert a.refcount(c[0]) == 1
+        a.free(c)
+
+    def test_cache_disabled_recycles_immediately(self):
+        a = BlockAllocator(num_blocks=9, block_size=4, prefix_cache=False)
+        h = chain_hash(None, [1, 2, 3, 4])
+        b = a.allocate(2)
+        a.register(b[0], h)          # no-op with the cache off
+        a.free(b)
+        assert a.cached_blocks == 0 and a.free_blocks == 8
+        assert a.match_probe([h]) == (0, 0)
+        assert a.match([h]) == []
+
+    def test_reset_cache_recycles_and_bumps_generation(self):
+        a = BlockAllocator(num_blocks=9, block_size=4, prefix_cache=True)
+        hs = _hashes(list(range(8)), 4)
+        b = a.allocate(2)
+        a.register(b[0], hs[0])
+        a.register(b[1], hs[1])
+        a.free(b)
+        gen = a.cache_gen
+        a.reset_cache()
+        assert a.cache_gen == gen + 1
+        assert a.cached_blocks == 0 and a.free_blocks == 8
+        assert a.match_probe(hs) == (0, 0)
+
+    def test_randomized_allocator_invariants(self):
+        """Property test over random allocate/match/free/reset traffic:
+        refcounts track live table membership exactly (never negative,
+        shared iff >= 2 tables), free+cached+in_use == num_blocks-1 at
+        every step, allocation never hands out a block a live table
+        still references, and the null block never escapes."""
+        rng = np.random.RandomState(SEED)
+        a = BlockAllocator(num_blocks=17, block_size=2, prefix_cache=True)
+        # a small prompt pool makes matches and sharing frequent
+        prompts = [rng.randint(0, 64, (8,)).tolist() for _ in range(6)]
+        tables = {}
+        next_id = 0
+        for _step in range(400):
+            op = rng.randint(0, 10)
+            if op < 5:
+                toks = prompts[rng.randint(len(prompts))]
+                hs = _hashes(toks, 2)
+                matched = a.match(hs)
+                try:
+                    fresh = a.allocate(len(hs) - len(matched))
+                except BlocksExhaustedError:
+                    if matched:
+                        a.free(matched)
+                else:
+                    held = {blk for t in tables.values() for blk in t}
+                    assert not set(fresh) & held
+                    for j, blk in enumerate(fresh):
+                        a.register(blk, hs[len(matched) + j])
+                    tables[next_id] = matched + fresh
+                    next_id += 1
+            elif op < 9 and tables:
+                tid = list(tables)[rng.randint(len(tables))]
+                a.free(tables.pop(tid))
+            else:
+                a.reset_cache()
+            st = a.stats()
+            assert sum(st.values()) == a.capacity
+            assert st["free"] == a.free_blocks
+            assert st["cached"] == a.cached_blocks
+            assert a.in_use == st["private"] + st["shared"]
+            counts = collections.Counter(
+                blk for t in tables.values() for blk in t)
+            assert 0 not in counts
+            assert a.in_use == len(counts)
+            for blk, c in counts.items():
+                assert a.refcount(blk) == c
+            assert sum(1 for c in counts.values() if c >= 2) \
+                == st["shared"]
+        for t in tables.values():
+            a.free(t)
+        assert a.in_use == 0
+        assert a.free_blocks + a.cached_blocks == a.capacity
+
+
+# ---------------------------------------------------------------------------
+# end to end: cached-prefix decode is bit-identical to cold decode
+# ---------------------------------------------------------------------------
+
+class TestPrefixReuse:
+    def test_warm_prompt_skips_prefill_and_is_bit_identical(
+            self, model_params):
+        """THE parity pin: the same prompt served twice on one engine —
+        the second run attaches 2 cached blocks (8 of 12 prompt tokens)
+        and prefills only 4, yet its tokens AND logprobs are bit-equal
+        to the cold run and to the full-forward greedy oracle."""
+        model, params, ref = model_params
+        rng = np.random.RandomState(101)
+        prompt = _prompt(rng, 12)
+        expect = _greedy_reference(ref, params, prompt, 6)
+        eng = _engine(model, params)
+        try:
+            assert eng.prefix_cache is True      # the knob's default
+            b0 = M.snapshot()
+            cold = eng.submit(prompt, max_tokens=6)
+            assert eng.result(cold, timeout=120) == expect
+            assert _delta(b0, PREFILL) == 12
+            assert _delta(b0, HIT) == 0 and _delta(b0, MISS) == 12
+            b1 = M.snapshot()
+            warm = eng.submit(prompt, max_tokens=6)
+            assert eng.result(warm, timeout=120) == expect
+            assert _delta(b1, PREFILL) == 4      # 12 - 2 cached blocks
+            assert _delta(b1, HIT) == 8 and _delta(b1, MISS) == 4
+            assert list(warm.logprobs) == list(cold.logprobs)
+        finally:
+            eng.close()
+        assert eng.allocator.in_use == 0
+
+    def test_shared_system_prompt_fanout_matches_cache_off(
+            self, model_params):
+        """The shared-prefix serving shape: one 16-token system prompt,
+        many suffixes. After the first request warms the cache, a
+        concurrent burst serves the system prompt from cached blocks —
+        outputs identical to a cache-off engine over the same compiled
+        programs."""
+        model, params, _ = model_params
+        rng = np.random.RandomState(102)
+        system = _prompt(rng, 16)
+        prompts = [system + _prompt(rng, 4) for _ in range(6)]
+
+        def run(prefix_cache):
+            eng = _engine(model, params, prefix_cache=prefix_cache)
+            outs = [None] * len(prompts)
+            try:
+                outs[0] = eng.generate(prompts[0], max_tokens=6,
+                                       timeout=120)
+                b1 = M.snapshot()
+
+                def worker(i):
+                    outs[i] = eng.generate(prompts[i], max_tokens=6,
+                                           timeout=120)
+                threads = [threading.Thread(target=worker, args=(i,))
+                           for i in range(1, len(prompts))]
+                for t in threads:
+                    t.start()
+                for t in threads:
+                    t.join()
+                hit = _delta(b1, HIT)
+            finally:
+                eng.close()
+            assert eng.allocator.in_use == 0
+            return outs, hit
+
+        cold_outs, cold_hit = run(prefix_cache=False)
+        warm_outs, warm_hit = run(prefix_cache=True)
+        assert warm_outs == cold_outs
+        assert cold_hit == 0
+        # every burst request matched the full 16-token system prompt
+        assert warm_hit == (len(prompts) - 1) * 16
+
+    def test_sampled_warm_request_is_bit_identical(self, model_params):
+        """Sampling composes with the cache: a seeded sampled request is
+        a pure function of (seed, emitted ordinal), so the warm replay
+        reproduces tokens and logprobs exactly."""
+        model, params, _ = model_params
+        rng = np.random.RandomState(103)
+        prompt = _prompt(rng, 12)
+        kw = dict(max_tokens=6, temperature=0.9, top_k=8, top_p=0.95,
+                  seed=7)
+        eng = _engine(model, params)
+        try:
+            s1 = eng.submit(prompt, **kw)
+            o1 = eng.result(s1, timeout=120)
+            b1 = M.snapshot()
+            s2 = eng.submit(prompt, **kw)
+            o2 = eng.result(s2, timeout=120)
+            assert _delta(b1, HIT) == 8
+            assert o2 == o1
+            assert list(s2.logprobs) == list(s1.logprobs)
+        finally:
+            eng.close()
+
+    def test_retired_blocks_park_cached_and_state_gauge_splits(
+            self, model_params):
+        """Retirement is a refcount decrement: full blocks park in the
+        cached pool (in_use drops to 0 — no leak), and the
+        hvd_tpu_gen_kv_blocks{state} gauge split sums to capacity."""
+        model, params, _ = model_params
+        rng = np.random.RandomState(104)
+        eng = _engine(model, params)
+        try:
+            eng.generate(_prompt(rng, 12), max_tokens=6, timeout=120)
+            alloc = eng.allocator
+            assert alloc.in_use == 0
+            # 12 prompt + 6 generated, newest never written: 17 cache
+            # slots -> 4 full blocks indexed and parked
+            assert alloc.cached_blocks == 4
+            snap = M.snapshot()
+            split = {s: snap[f'hvd_tpu_gen_kv_blocks{{state="{s}"}}']
+                     for s in ("free", "cached", "private", "shared")}
+            assert split == {"free": alloc.capacity - 4, "cached": 4,
+                             "private": 0, "shared": 0}
+        finally:
+            eng.close()
+
+    def test_preemption_recompute_rematches_cache(self, model_params):
+        """A preempted sequence's freed full blocks park in the cached
+        pool; readmission re-matches them, so the resume prefill is a
+        fraction of the cold recompute — with identical outputs."""
+        model, params, ref = model_params
+        rng = np.random.RandomState(105)
+        p1, p2 = _prompt(rng, 6), _prompt(rng, 6)
+
+        def run(prefix_cache):
+            before = M.snapshot()
+            eng = _engine(model, params, num_blocks=12,
+                          prefix_cache=prefix_cache)
+            try:
+                s1 = eng.submit(p1, max_tokens=20)
+                s2 = eng.submit(p2, max_tokens=20)
+                o1 = eng.result(s1, timeout=240)
+                o2 = eng.result(s2, timeout=240)
+            finally:
+                eng.close()
+            assert eng.allocator.in_use == 0
+            return (o1, o2, _delta(before, PREEMPTIONS),
+                    _delta(before, PREFILL), _delta(before, HIT))
+
+        o1c, o2c, pre_c, prefill_c, hit_c = run(prefix_cache=False)
+        o1w, o2w, pre_w, prefill_w, hit_w = run(prefix_cache=True)
+        assert o1c == o1w == _greedy_reference(ref, params, p1, 20)
+        assert o2c == o2w == _greedy_reference(ref, params, p2, 20)
+        # the squeeze forces recompute in both modes; only the cached
+        # mode serves the re-prefill from parked blocks
+        assert pre_c >= 1 and pre_w >= 1
+        assert hit_c == 0 and hit_w > 0
+        assert prefill_w < prefill_c
+
+    def test_admission_via_cached_blocks_evicts_before_preempting(
+            self, model_params):
+        """The seeded serving.evict drill under cache pressure: with the
+        pool dominated by cached blocks, new admissions evict LRU cached
+        blocks and NEVER preempt a running sequence — an armed
+        serving.evict:error would fail any preemption victim, and none
+        fires. Also pins cache-aware admission: the prompts are only
+        admissible because cached blocks count as evictable."""
+        model, params, ref = model_params
+        rng = np.random.RandomState(106)
+        pa, pb, pc, pd = (_prompt(rng, 8) for _ in range(4))
+        F.configure("serving.evict:error", seed=SEED)
+        eng = _engine(model, params, num_blocks=9)
+        try:
+            # warm phase: two retired sequences park 3 full blocks each
+            # (8 prompt + 8 generated, 15 written -> 3 full): the 8-block
+            # pool ends up 6 cached / 2 free
+            oa = eng.generate(pa, max_tokens=8, timeout=120)
+            ob = eng.generate(pb, max_tokens=8, timeout=120)
+            alloc = eng.allocator
+            assert alloc.cached_blocks == 6 and alloc.free_blocks == 2
+            b1 = M.snapshot()
+            # pressure phase: two fresh prompts need 3 blocks each —
+            # admissible only by evicting cached blocks (2 free < 3)
+            sc = eng.submit(pc, max_tokens=4)
+            sd = eng.submit(pd, max_tokens=4)
+            oc = eng.result(sc, timeout=240)
+            od = eng.result(sd, timeout=240)
+            assert oc == _greedy_reference(ref, params, pc, 4)
+            assert od == _greedy_reference(ref, params, pd, 4)
+            assert _delta(b1, EVICTIONS) >= 1
+            assert _delta(b1, PREEMPTIONS) == 0
+        finally:
+            eng.close()
+        assert oa == _greedy_reference(ref, params, pa, 8)
+        assert ob == _greedy_reference(ref, params, pb, 8)
+
+    def test_cache_off_engine_reports_and_counts_nothing(
+            self, model_params):
+        model, params, ref = model_params
+        rng = np.random.RandomState(107)
+        prompt = _prompt(rng, 12)
+        before = M.snapshot()
+        eng = _engine(model, params, prefix_cache=False)
+        try:
+            assert eng.prefix_cache is False
+            out1 = eng.generate(prompt, max_tokens=4, timeout=120)
+            out2 = eng.generate(prompt, max_tokens=4, timeout=120)
+            assert out1 == out2 \
+                == _greedy_reference(ref, params, prompt, 4)
+            assert _delta(before, HIT) == 0 and _delta(before, MISS) == 0
+            assert _delta(before, PREFILL) == 24     # full prefill twice
+            assert eng.allocator.cached_blocks == 0
+        finally:
+            eng.close()
+
+    def test_hot_reload_resets_prefix_cache(self, model_params, tmp_path):
+        """A params hot-swap invalidates cached K/V *contents*: the
+        index drops on the first post-swap step, the next request runs
+        a full cold prefill (hit == 0), and the cache re-warms under
+        the new checkpoint."""
+        from horovod_tpu import checkpointing
+        model, params, ref = model_params
+        rng = np.random.RandomState(108)
+        prompt = _prompt(rng, 12)
+        expect = _greedy_reference(ref, params, prompt, 4)
+        checkpointing.save(str(tmp_path), 1, params)
+        eng = GenerationEngine(model, checkpoint_dir=str(tmp_path),
+                               block_size=4, num_blocks=33, max_seqs=4,
+                               prefill_chunk=8, deadline_ms=0,
+                               reload_poll_seconds=0)
+        try:
+            assert eng.generate(prompt, max_tokens=4, timeout=120) \
+                == expect
+            b1 = M.snapshot()
+            assert eng.generate(prompt, max_tokens=4, timeout=120) \
+                == expect
+            assert _delta(b1, HIT) == 8              # warmed
+            checkpointing.save(str(tmp_path), 5, params)
+            assert eng.reload() is True
+            b2 = M.snapshot()
+            assert eng.generate(prompt, max_tokens=4, timeout=120) \
+                == expect
+            assert _delta(b2, HIT) == 0              # cache was dropped
+            b3 = M.snapshot()
+            assert eng.generate(prompt, max_tokens=4, timeout=120) \
+                == expect
+            assert _delta(b3, HIT) == 8              # re-warmed
+        finally:
+            eng.close()
+
+
+# ---------------------------------------------------------------------------
+# server passthrough: /healthz reports the block-pool split
+# ---------------------------------------------------------------------------
+
+class TestPrefixHTTP:
+    def test_healthz_reports_prefix_cache_and_block_states(
+            self, model_params):
+        model, params, _ = model_params
+        rng = np.random.RandomState(109)
+        gen = _engine(model, params)
+        with serving.InferenceServer(engine=None, gen_engine=gen,
+                                     port=0, addr="127.0.0.1") as srv:
+            gen.generate(_prompt(rng, 12), max_tokens=6, timeout=120)
+            with urlopen(f"http://127.0.0.1:{srv.port}/healthz",
+                         timeout=30) as resp:
+                doc = json.loads(resp.read())
+        assert doc["prefix_cache"] is True
+        split = doc["kv_blocks"]
+        assert set(split) == {"free", "cached", "private", "shared"}
+        assert sum(split.values()) == gen.allocator.capacity
+        assert split["cached"] == 4 and split["private"] == 0
