@@ -38,6 +38,7 @@ COMMON_SUITES = [
      "python -m pytest tests/ -q -m 'not integration and not chaos' "
      "--ignore=tests/test_checkpointing.py "
      "--ignore=tests/test_serving.py "
+     "--ignore=tests/test_fleet.py "
      "--ignore=tests/test_generation.py "
      "--ignore=tests/test_generation_sampling.py "
      "--ignore=tests/test_generation_prefix.py", 30),
@@ -45,6 +46,7 @@ COMMON_SUITES = [
      "--ignore=tests/test_coordinator_recovery.py "
      "--ignore=tests/test_checkpointing.py "
      "--ignore=tests/test_serving.py "
+     "--ignore=tests/test_fleet.py "
      "--ignore=tests/test_generation.py "
      "--ignore=tests/test_generation_sampling.py "
      "--ignore=tests/test_generation_prefix.py", 20),
@@ -67,6 +69,13 @@ COMMON_SUITES = [
     ("serving",
      "env HVD_TPU_FAULT_SEED=1234 "
      "python -m pytest tests/test_serving.py -q", 20),
+    # serving fleet: replica router health/balancing, per-tenant fair
+    # admission, rolling hot-reload, and the seeded fleet.route /
+    # fleet.drain / fleet.health chaos drills — pinned seed; owns its
+    # file exclusively (unit+chaos suites ignore it)
+    ("serving-fleet",
+     "env HVD_TPU_FAULT_SEED=1234 "
+     "python -m pytest tests/test_fleet.py -q", 20),
     # continuous-batching generation: paged KV cache, decode/full-forward
     # parity, preemption, the seeded prefill/decode/evict chaos drills,
     # the device-resident loop suite (on-device sampling, seeded

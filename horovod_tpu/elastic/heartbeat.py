@@ -72,9 +72,13 @@ class HeartbeatSender:
     """
 
     def __init__(self, client, hostname: str, local_rank, rank,
-                 interval: Optional[float] = None):
+                 interval: Optional[float] = None,
+                 key: Optional[str] = None):
         self._client = client
-        self._key = heartbeat_key(hostname, local_rank)
+        # key= overrides the elastic host:slot scheme — the serving
+        # fleet reuses this loop with opaque replica-id keys
+        self._key = key if key is not None else heartbeat_key(
+            hostname, local_rank)
         self._rank = rank
         self._interval = interval if interval is not None else float(
             _config.Config().get(_config.HEARTBEAT_INTERVAL))
@@ -114,48 +118,67 @@ class HeartbeatSender:
             self._stop.wait(self._interval)
 
 
-class HeartbeatMonitor:
-    """Driver-side liveness bookkeeping + declaration thread.
+class LivenessMonitor:
+    """Generic armed-then-silent liveness bookkeeping over opaque keys.
 
-    ``on_dead(host, slot, rank)`` runs on the monitor thread when a slot
-    armed by a first beat goes silent past the timeout. The driver passes
-    a callback that fires the host event (kill -> exit -> FAILURE ->
-    blacklist), keeping recovery single-pathed.
+    The mechanism the elastic driver trusts — a key is *armed* by its
+    first beat, *declared dead* after ``timeout`` seconds of beat
+    silence (receipt clock only, so sender clock skew cannot
+    misdeclare), declared exactly once, never declared before arming —
+    with nothing elastic-specific in it, so the serving fleet's router
+    can reuse it for replica liveness with replica-id keys.
+
+    ``on_dead(key, meta)`` runs on the monitor thread (or a direct
+    :meth:`check_now` caller) for each declaration; ``meta`` is whatever
+    string the last :meth:`observe` recorded. Unlike the elastic flow —
+    where death is terminal for the process and re-arming means a fresh
+    worker — a declared key is remembered in a dead-set, and when its
+    beats *resume* the optional ``on_alive(key)`` callback fires
+    (the router's re-admission signal).
+
+    ``timeout`` is clamped to at least 2x ``poll_interval`` so a single
+    dropped beat can never declare a healthy sender; detection latency
+    is bounded by timeout + poll < 2x timeout.
     """
 
-    def __init__(self, on_dead: Callable[[str, int, str], None],
-                 timeout: Optional[float] = None,
-                 poll_interval: Optional[float] = None):
-        cfg = _config.Config()
-        self._on_dead = on_dead
-        self._timeout = timeout if timeout is not None else float(
-            cfg.get(_config.HEARTBEAT_TIMEOUT))
-        # poll at the beat interval: detection latency is then bounded by
-        # timeout + interval < 2 x timeout for any sane interval
-        self._poll = poll_interval if poll_interval is not None else max(
-            0.1, float(cfg.get(_config.HEARTBEAT_INTERVAL)))
+    def __init__(self, on_dead: Callable[[str, str], None],
+                 timeout: float, poll_interval: float,
+                 on_alive: Optional[Callable[[str], None]] = None,
+                 label: str = "liveness",
+                 thread_name: str = "hvd-liveness-monitor"):
+        self._on_dead_key = on_dead
+        self._on_alive = on_alive
+        self._label = label
+        self._thread_name = thread_name
+        self._timeout = float(timeout)
+        self._poll = max(0.05, float(poll_interval))
         # A timeout at or below the beat interval would declare perfectly
-        # healthy workers dead between beats, thrashing the blacklist
-        # until the cluster is exhausted — clamp to 2x the interval so a
-        # single dropped beat never kills a worker either.
+        # healthy senders dead between beats — clamp to 2x the interval so
+        # a single dropped beat never triggers a declaration either.
         floor = 2.0 * self._poll
         if 0 < self._timeout < floor:
             log.warning(
-                "elastic: HVD_TPU_HEARTBEAT_TIMEOUT (%.1fs) is below 2x "
-                "the heartbeat interval; clamping to %.1fs",
-                self._timeout, floor)
+                "%s: heartbeat timeout (%.1fs) is below 2x the beat "
+                "interval; clamping to %.1fs",
+                self._label, self._timeout, floor)
             self._timeout = floor
-        self._lock = _locks.lock("heartbeat.HeartbeatMonitor._lock")
-        #: (host, slot) -> (last receipt monotonic, last reported rank)
-        self._beats: Dict[Tuple[str, int], Tuple[float, str]] = {}
+        self._lock = _locks.lock("heartbeat.LivenessMonitor._lock")
+        #: key -> (last receipt monotonic, last reported meta)
+        self._beats: Dict[str, Tuple[float, str]] = {}
+        #: keys declared dead whose next beat is a recovery, not an arming
+        self._dead_keys: Dict[str, bool] = {}
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
+
+    @property
+    def timeout(self) -> float:
+        return self._timeout
 
     def start(self) -> None:
         if self._timeout <= 0 or self._thread is not None:
             return
         self._thread = threading.Thread(
-            target=self._loop, name="hvd-heartbeat-monitor", daemon=True)
+            target=self._loop, name=self._thread_name, daemon=True)
         self._thread.start()
 
     def stop(self) -> None:
@@ -164,33 +187,37 @@ class HeartbeatMonitor:
         if thread is not None:
             thread.join(timeout=2)
 
-    # -- bookkeeping (driver/rendezvous callbacks) ---------------------------
-    def observe(self, key: str, value: bytes) -> None:
-        """Record a beat's receipt (wired as the ``heartbeat`` scope's PUT
-        handler). The key is ``hostname:local_rank``; the value is the
-        worker's rank, used only to label the miss counter."""
-        host, _, local_rank = key.rpartition(":")
-        try:
-            slot = int(local_rank)
-        except ValueError:
-            return
-        rank = value.decode(errors="replace") if value else "?"
+    # -- bookkeeping ---------------------------------------------------------
+    def observe_key(self, key: str, meta: str = "?") -> None:
+        """Record a beat's receipt for ``key``; fires ``on_alive`` when
+        the key was previously declared dead."""
         with self._lock:
-            self._beats[(host, slot)] = (time.monotonic(), rank)
+            self._beats[key] = (time.monotonic(), meta)
+            revived = self._dead_keys.pop(key, None) is not None
+        if revived and self._on_alive is not None:
+            log.info("%s: beats from %s resumed", self._label, key)
+            try:
+                self._on_alive(key)
+            except Exception:
+                log.exception("%s: recovery handler failed for %s",
+                              self._label, key)
 
-    def forget(self, host: str, slot: int) -> None:
-        """Drop a slot (its worker exited — silence is now expected)."""
+    def forget_key(self, key: str) -> None:
+        """Drop a key (its sender left on purpose — silence is now
+        expected and a later return is a fresh arming, not a recovery)."""
         with self._lock:
-            self._beats.pop((host, slot), None)
+            self._beats.pop(key, None)
+            self._dead_keys.pop(key, None)
 
     def reset(self) -> None:
         """New generation: nothing already observed still applies."""
         with self._lock:
             self._beats.clear()
+            self._dead_keys.clear()
 
-    def last_beat_age(self, host: str, slot: int) -> Optional[float]:
+    def key_age(self, key: str) -> Optional[float]:
         with self._lock:
-            entry = self._beats.get((host, slot))
+            entry = self._beats.get(key)
         return None if entry is None else time.monotonic() - entry[0]
 
     # -- declaration ---------------------------------------------------------
@@ -199,22 +226,20 @@ class HeartbeatMonitor:
         from tests for deterministic timing)."""
         now = time.monotonic()
         with self._lock:
-            dead = [(host, slot, rank)
-                    for (host, slot), (t, rank) in self._beats.items()
+            dead = [(key, meta) for key, (t, meta) in self._beats.items()
                     if now - t > self._timeout]
-            for host, slot, _rank in dead:
-                del self._beats[(host, slot)]
-        for host, slot, rank in dead:
-            _M_MISSES.labels(rank=rank).inc()
-            log.warning(
-                "elastic: no heartbeat from %s[%s] (rank %s) for more than "
-                "%.1fs; declaring it dead and triggering blacklist/"
-                "re-rendezvous", host, slot, rank, self._timeout)
+            for key, _meta in dead:
+                del self._beats[key]
+                self._dead_keys[key] = True
+        for key, meta in dead:
             try:
-                self._on_dead(host, slot, rank)
+                self._declare_dead(key, meta)
             except Exception:
-                log.exception("elastic: heartbeat-death handler failed "
-                              "for %s[%s]", host, slot)
+                log.exception("%s: death handler failed for %s",
+                              self._label, key)
+
+    def _declare_dead(self, key: str, meta: str) -> None:
+        self._on_dead_key(key, meta)
 
     def _loop(self) -> None:
         while not self._stop.is_set():
@@ -222,3 +247,70 @@ class HeartbeatMonitor:
             if self._stop.is_set():
                 return
             self.check_now()
+
+
+class HeartbeatMonitor(LivenessMonitor):
+    """Driver-side liveness bookkeeping + declaration thread.
+
+    ``on_dead(host, slot, rank)`` runs on the monitor thread when a slot
+    armed by a first beat goes silent past the timeout. The driver passes
+    a callback that fires the host event (kill -> exit -> FAILURE ->
+    blacklist), keeping recovery single-pathed.
+
+    This is the elastic skin over :class:`LivenessMonitor`: keys are
+    ``hostname:local_rank``, metadata is the worker's reported rank
+    (labels the miss counter), and defaults come from the
+    ``HVD_TPU_HEARTBEAT_TIMEOUT`` / ``HVD_TPU_HEARTBEAT_INTERVAL``
+    knobs.
+    """
+
+    def __init__(self, on_dead: Callable[[str, int, str], None],
+                 timeout: Optional[float] = None,
+                 poll_interval: Optional[float] = None):
+        cfg = _config.Config()
+        self._on_dead = on_dead
+        if timeout is None:
+            timeout = float(cfg.get(_config.HEARTBEAT_TIMEOUT))
+        # poll at the beat interval: detection latency is then bounded by
+        # timeout + interval < 2 x timeout for any sane interval
+        if poll_interval is None:
+            poll_interval = max(
+                0.1, float(cfg.get(_config.HEARTBEAT_INTERVAL)))
+        super().__init__(on_dead=self._unused_, timeout=timeout,
+                         poll_interval=poll_interval, label="elastic",
+                         thread_name="hvd-heartbeat-monitor")
+
+    @staticmethod
+    def _unused_(key: str, meta: str) -> None:  # _declare_dead overrides
+        raise AssertionError("unreachable")
+
+    # -- bookkeeping (driver/rendezvous callbacks) ---------------------------
+    def observe(self, key: str, value: bytes) -> None:
+        """Record a beat's receipt (wired as the ``heartbeat`` scope's PUT
+        handler). The key is ``hostname:local_rank``; the value is the
+        worker's rank, used only to label the miss counter."""
+        host, _, local_rank = key.rpartition(":")
+        try:
+            int(local_rank)
+        except ValueError:
+            return
+        rank = value.decode(errors="replace") if value else "?"
+        self.observe_key(heartbeat_key(host, int(local_rank)), meta=rank)
+
+    def forget(self, host: str, slot: int) -> None:
+        """Drop a slot (its worker exited — silence is now expected)."""
+        self.forget_key(heartbeat_key(host, slot))
+
+    def last_beat_age(self, host: str, slot: int) -> Optional[float]:
+        return self.key_age(heartbeat_key(host, slot))
+
+    # -- declaration ---------------------------------------------------------
+    def _declare_dead(self, key: str, meta: str) -> None:
+        host, _, local_rank = key.rpartition(":")
+        slot, rank = int(local_rank), meta
+        _M_MISSES.labels(rank=rank).inc()
+        log.warning(
+            "elastic: no heartbeat from %s[%s] (rank %s) for more than "
+            "%.1fs; declaring it dead and triggering blacklist/"
+            "re-rendezvous", host, slot, rank, self._timeout)
+        self._on_dead(host, slot, rank)

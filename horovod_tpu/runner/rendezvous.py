@@ -129,10 +129,10 @@ class _KVHandler(_http.QuietHandler):
         self._respond(200)
 
 
-class _KVServer(_http.QuietThreadingHTTPServer):
-    """Shared quiet/threaded/no-join-on-close server base (_http.py);
-    the KV store owns its own bind/restart lifecycle, so only the server
-    class is reused here, not start_server()."""
+class _KVServer(_http.AsyncHTTPServer):
+    """Shared quiet/async/selector server base (_http.py); the KV store
+    owns its own bind/restart lifecycle, so only the server class is
+    reused here, not start_server()."""
 
 
 #: launcher-side fault site: an ``error`` makes the store answer 503 (a

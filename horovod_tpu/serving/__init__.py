@@ -12,10 +12,16 @@ training side already trusts:
   serving mesh via the resharding checkpoint reader and hot-reloads
   newer committed steps with an atomic swap (zero downtime, in-flight
   requests never split across checkpoints);
-* :mod:`.server` — :class:`InferenceServer`: threaded stdlib HTTP
-  front-end (``POST /v1/infer``, ``POST /v1/generate``,
-  ``GET /healthz``) where admission control degrades overload to fast
-  429/503 backpressure;
+* :mod:`.server` — :class:`InferenceServer`: HTTP front-end on the
+  shared async server (``POST /v1/infer``, ``POST /v1/generate``,
+  ``POST /v1/reload``, ``GET /healthz``) where admission control
+  degrades overload to fast 429/503 backpressure;
+* :mod:`.fleet` — the router tier over N replica servers:
+  :class:`~horovod_tpu.serving.fleet.FleetRouter` (health-aware
+  least-outstanding balancing, heartbeat + circuit ejection),
+  per-tenant fair admission, and
+  :func:`~horovod_tpu.serving.fleet.rolling_reload` for zero-downtime
+  fleet-wide checkpoint pushes;
 * :mod:`.generation` — the continuous-batching decode plane:
   :class:`GenerationEngine` serves autoregressive generation from a
   paged KV cache with iteration-level scheduling, reusing the same
@@ -43,3 +49,4 @@ from .engine import (InferenceEngine, ParamsLifecycle,  # noqa: F401
                      ReloadCrashed, wait_for_step)
 from .server import InferenceServer                               # noqa: F401
 from .generation import GenerationEngine                          # noqa: F401
+from . import fleet                                               # noqa: F401

@@ -1,0 +1,41 @@
+"""The serving fleet: a router tier over N replica servers.
+
+One :class:`~horovod_tpu.serving.server.InferenceServer` is a demo; a
+service is N of them behind something that knows which ones are alive.
+This package is that something:
+
+* :mod:`.router` — :class:`FleetRouter`: least-outstanding balancing
+  over routable replicas, replica liveness from heartbeats (the elastic
+  layer reused with replica-id keys) plus passive circuit breakers with
+  half-open probes, ``X-HVD-TPU-Request-Id`` propagation, and
+  :class:`ReplicaHeartbeat` for the replica side;
+* :mod:`.tenancy` — per-tenant admission in front of dispatch:
+  API-key/header resolution, quota (a flooding tenant gets its own
+  429s), priority classes, weighted fair dequeue;
+* :mod:`.rollout` — :func:`rolling_reload`: fleet-wide checkpoint
+  swaps one drained replica at a time, aborting fail-static on a
+  wedged drain.
+
+Quick start (replicas are ordinary ``InferenceServer``\\ s)::
+
+    from horovod_tpu.serving import fleet
+
+    router = fleet.FleetRouter({"r0": f"http://127.0.0.1:{p0}",
+                                "r1": f"http://127.0.0.1:{p1}"})
+    with router:
+        beat = fleet.ReplicaHeartbeat(router.url, "r0")
+        beat.start()                    # r0 arms and stays routable
+        ...                             # POST router.url + /v1/infer
+        fleet.rolling_reload(router)    # zero-downtime checkpoint push
+
+See docs/inference.md for the topology, tenant configuration, and the
+rollout walkthrough; docs/robustness.md for the ``fleet.*`` chaos
+drills.
+"""
+
+from .router import (FleetRouter, ReplicaHeartbeat,       # noqa: F401
+                     REQUEST_ID_HEADER)
+from .tenancy import (FairScheduler, Tenant,              # noqa: F401
+                      TenantQuotaError, TenantRegistry,
+                      API_KEY_HEADER, TENANT_HEADER)
+from .rollout import RolloutAborted, rolling_reload       # noqa: F401

@@ -1,0 +1,596 @@
+"""The fleet router: health-aware balancing over N replica servers.
+
+:class:`FleetRouter` fronts a set of replica endpoints (each an
+:class:`~horovod_tpu.serving.server.InferenceServer`, infer and/or
+generate plane) behind one async HTTP front-end and owns three jobs:
+
+* **balancing** — each proxied request goes to the routable replica
+  with the fewest outstanding requests (the router's own in-flight
+  count, so no replica cooperation is needed), published per replica as
+  ``hvd_tpu_fleet_outstanding{replica}``.
+* **health** — two independent signals remove a replica from routing:
+
+  - *active*: replicas beat ``POST /fleet/heartbeat/<replica>`` every
+    ``HVD_TPU_FLEET_HEARTBEAT_INTERVAL`` seconds (the elastic
+    :class:`~horovod_tpu.elastic.heartbeat.LivenessMonitor` reused with
+    replica-id keys). An armed-then-silent replica is ejected within
+    2x ``HVD_TPU_FLEET_HEARTBEAT_TIMEOUT`` and re-admitted the moment
+    its beats resume.
+  - *passive*: ``HVD_TPU_FLEET_CIRCUIT_THRESHOLD`` consecutive
+    connect errors / 5xx responses open the replica's circuit; a
+    half-open ``GET /healthz`` probe (full-jitter backoff via
+    :mod:`horovod_tpu.retry`) re-closes it on success. Connect errors
+    additionally fail the request over to the next routable replica.
+
+  Ejections from either signal are
+  ``hvd_tpu_fleet_ejections_total{replica,reason}``.
+* **admission** — every proxied request passes the per-tenant
+  :class:`~horovod_tpu.serving.fleet.tenancy.FairScheduler` first
+  (quota 429s, weighted fair dequeue); fleet capacity follows the live
+  routable-replica count, so an ejection shrinks admission instead of
+  stacking requests on a corpse.
+
+Requests carry ``X-HVD-TPU-Request-Id`` (stamped here when absent,
+forwarded to the replica, echoed in both responses) so one failed
+request is traceable across tiers.
+
+Chaos site ``fleet.route``: fired after admission, before replica
+selection; an injected error answers 503 without touching any replica
+(the router's own blast-radius drill).
+"""
+
+import json
+import logging
+import threading
+import time
+import urllib.error
+import urllib.request
+import uuid
+from typing import Dict, Iterable, Mapping, Optional, Tuple, Union
+
+from ... import _http
+from ... import _locks
+from ... import config as _config
+from ... import faults as _faults
+from ... import metrics as _metrics
+from ... import retry as _retry
+from ...elastic.heartbeat import HeartbeatSender, LivenessMonitor
+from .tenancy import FairScheduler, TenantQuotaError, TenantRegistry
+from ..batcher import DeadlineExceededError
+
+log = logging.getLogger("horovod_tpu.fleet")
+
+HEARTBEAT_PATH = "/fleet/heartbeat/"
+REQUEST_ID_HEADER = "X-HVD-TPU-Request-Id"
+
+_FP_ROUTE = _faults.FaultPoint("fleet.route")
+_FP_HEALTH = _faults.FaultPoint("fleet.health",
+                                exc=_faults.InjectedTransientFault)
+
+_M_OUTSTANDING = _metrics.gauge(
+    "hvd_tpu_fleet_outstanding",
+    "Requests the router currently has in flight against each replica "
+    "(the least-outstanding balancing signal; a draining replica must "
+    "reach 0 before its rolling-reload swap).",
+    labels=("replica",))
+_M_EJECTIONS = _metrics.counter(
+    "hvd_tpu_fleet_ejections_total",
+    "Replicas removed from routing, by reason: heartbeat (armed then "
+    "silent past the timeout) or circuit (consecutive connect-error/5xx "
+    "streak). Re-admission is automatic on recovery.",
+    labels=("replica", "reason"))
+_M_REQUESTS = _metrics.counter(
+    "hvd_tpu_fleet_requests_total",
+    "Router HTTP responses by code: 200 proxied OK, 429 tenant "
+    "quota/deadline, 503 no routable replica or injected fleet.route, "
+    "plus replica codes relayed verbatim.",
+    labels=("code",))
+
+
+class _Replica:
+    """Router-side record for one replica endpoint (state guarded by the
+    router lock; ``outstanding`` also mirrors to the gauge)."""
+
+    __slots__ = ("id", "base_url", "outstanding", "draining", "hb_dead",
+                 "circuit_open", "failure_streak", "probe_attempt",
+                 "next_probe_at")
+
+    def __init__(self, replica_id: str, base_url: str):
+        self.id = replica_id
+        self.base_url = base_url.rstrip("/")
+        self.outstanding = 0
+        self.draining = False
+        self.hb_dead = False
+        self.circuit_open = False
+        self.failure_streak = 0
+        self.probe_attempt = 0
+        self.next_probe_at = 0.0
+
+    @property
+    def routable(self) -> bool:
+        return not (self.draining or self.hb_dead or self.circuit_open)
+
+    def state(self) -> str:
+        if self.hb_dead:
+            return "dead"
+        if self.circuit_open:
+            return "circuit_open"
+        if self.draining:
+            return "draining"
+        return "up"
+
+
+class _RouterHandler(_http.QuietHandler):
+    """Front-end handler; all logic lives on ``self.server.router``."""
+
+    def do_GET(self):  # noqa: N802 — BaseHTTPRequestHandler contract
+        if self.path.split("?", 1)[0] != "/healthz":
+            self._send(404, {"error": "not found"})
+            return
+        self._send(200, self.server.router.health_doc())
+
+    def do_POST(self):  # noqa: N802
+        path = self.path.split("?", 1)[0]
+        if path.startswith(HEARTBEAT_PATH):
+            replica_id = path[len(HEARTBEAT_PATH):]
+            if self.server.router.observe_beat(replica_id):
+                self._send(200, {"ok": True})
+            else:
+                self._send(404, {"error": f"unknown replica {replica_id!r}"})
+            return
+        if path not in ("/v1/infer", "/v1/generate"):
+            self._send(404, {"error": "not found"})
+            return
+        self.server.router._proxy(self, path)
+
+    def _send(self, code: int, doc: dict,
+              request_id: Optional[str] = None) -> None:
+        body = json.dumps(doc).encode("utf-8")
+        _M_REQUESTS.labels(code=str(code)).inc()
+        try:
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            if request_id:
+                self.send_header(REQUEST_ID_HEADER, request_id)
+            self.end_headers()
+            self.wfile.write(body)
+        except OSError:
+            self.close_connection = True
+
+
+class FleetRouter:
+    """Router tier over replica serving endpoints (see module docstring).
+
+    ``replicas`` maps replica id -> base URL (``"http://host:port"``; a
+    bare ``"host:port"`` is accepted) or is an iterable of base URLs
+    (ids are assigned ``r0..rN``). The set is fixed at construction;
+    health state (heartbeat, circuit, draining) changes at runtime.
+
+    ``start()`` binds the async HTTP front-end (``HVD_TPU_FLEET_PORT``,
+    0 = ephemeral) and starts the liveness monitor + circuit-probe
+    thread; ``stop()`` tears all three down. Requests proxied:
+    ``POST /v1/infer`` and ``POST /v1/generate``; control plane:
+    ``GET /healthz``, ``POST /fleet/heartbeat/<replica-id>``.
+    """
+
+    def __init__(self,
+                 replicas: Union[Mapping[str, str], Iterable[str]],
+                 port: Optional[int] = None, addr: str = "0.0.0.0",
+                 verbose: bool = False,
+                 tenants: Optional[TenantRegistry] = None,
+                 heartbeat_timeout: Optional[float] = None,
+                 heartbeat_interval: Optional[float] = None,
+                 request_timeout: Optional[float] = None):
+        cfg = _config.live_config()
+        if isinstance(replicas, Mapping):
+            items = list(replicas.items())
+        else:
+            items = [(f"r{i}", url) for i, url in enumerate(replicas)]
+        if not items:
+            raise ValueError("FleetRouter needs at least one replica")
+        self._replicas: Dict[str, _Replica] = {}
+        for replica_id, url in items:
+            url = str(url)
+            if "//" not in url:
+                url = "http://" + url
+            self._replicas[str(replica_id)] = _Replica(str(replica_id), url)
+        self._lock = _locks.lock("fleet.FleetRouter._lock")
+        self._requested_port = int(cfg.get(_config.FLEET_PORT)
+                                   if port is None else port)
+        self._addr = addr
+        self._verbose = verbose
+        self._request_timeout = float(
+            cfg.get(_config.HTTP_READ_TIMEOUT)
+            if request_timeout is None else request_timeout) or 30.0
+        self._per_replica = max(1, int(
+            cfg.get(_config.FLEET_REPLICA_CONCURRENCY)))
+        self._circuit_threshold = max(1, int(
+            cfg.get(_config.FLEET_CIRCUIT_THRESHOLD)))
+        self._probe_policy = _retry.RetryPolicy(
+            max_attempts=1,
+            initial_backoff=float(cfg.get(_config.FLEET_PROBE_BACKOFF)),
+            max_backoff=float(cfg.get(_config.FLEET_PROBE_MAX_BACKOFF)))
+        self.tenants = tenants if tenants is not None else TenantRegistry(
+            cfg=cfg)
+        self.scheduler = FairScheduler(capacity_fn=self._capacity)
+        hb_interval = float(cfg.get(_config.FLEET_HEARTBEAT_INTERVAL)
+                            if heartbeat_interval is None
+                            else heartbeat_interval)
+        hb_timeout = float(cfg.get(_config.FLEET_HEARTBEAT_TIMEOUT)
+                           if heartbeat_timeout is None
+                           else heartbeat_timeout)
+        self.monitor = LivenessMonitor(
+            on_dead=self._on_replica_dead, on_alive=self._on_replica_alive,
+            timeout=hb_timeout, poll_interval=max(0.05, hb_interval),
+            label="fleet", thread_name="hvd-fleet-hb-monitor")
+        #: routable-replica count, mirrored on every health/drain change;
+        #: read lock-free by the scheduler's capacity_fn
+        self._routable_count = len(self._replicas)
+        self._httpd = None
+        self._stop_probe = threading.Event()
+        self._probe_thread: Optional[threading.Thread] = None
+        for replica in self._replicas.values():
+            _M_OUTSTANDING.labels(replica=replica.id).set(0)
+
+    # -- lifecycle -----------------------------------------------------------
+    @property
+    def port(self) -> int:
+        if self._httpd is None:
+            raise RuntimeError("FleetRouter not started")
+        return self._httpd.server_address[1]
+
+    @property
+    def url(self) -> str:
+        return f"http://127.0.0.1:{self.port}"
+
+    def start(self) -> int:
+        if self._httpd is None:
+            self._httpd = _http.start_server(
+                _RouterHandler, port=self._requested_port, addr=self._addr,
+                name="hvd-tpu-fleet-http", verbose=self._verbose)
+            self._httpd.router = self
+            self.monitor.start()
+            self._stop_probe.clear()
+            self._probe_thread = threading.Thread(
+                target=self._probe_loop, name="hvd-fleet-probe", daemon=True)
+            self._probe_thread.start()
+            log.info("fleet: router on %s:%d fronting %d replica(s)",
+                     self._addr, self.port, len(self._replicas))
+        return self.port
+
+    def stop(self) -> None:
+        self._stop_probe.set()
+        thread, self._probe_thread = self._probe_thread, None
+        if thread is not None:
+            thread.join(timeout=2)
+        self.monitor.stop()
+        self.scheduler.close()
+        httpd, self._httpd = self._httpd, None
+        _http.stop_server(httpd)
+
+    def __enter__(self):
+        self.start()
+        return self
+
+    def __exit__(self, *exc):
+        self.stop()
+
+    # -- introspection / control plane ---------------------------------------
+    def replica_ids(self) -> Tuple[str, ...]:
+        return tuple(sorted(self._replicas))
+
+    def replica_url(self, replica_id: str) -> str:
+        return self._replicas[replica_id].base_url
+
+    def outstanding(self, replica_id: str) -> int:
+        with self._lock:
+            return self._replicas[replica_id].outstanding
+
+    def routable_count(self) -> int:
+        return self._routable_count
+
+    def health_doc(self) -> dict:
+        with self._lock:
+            replicas = {r.id: {"state": r.state(),
+                               "outstanding": r.outstanding,
+                               "url": r.base_url}
+                        for r in self._replicas.values()}
+            routable = self._routable_count
+        return {"status": "routing" if routable else "degraded",
+                "routable": routable, "replicas": replicas,
+                "tenants": self.scheduler.stats()}
+
+    def observe_beat(self, replica_id: str) -> bool:
+        if replica_id not in self._replicas:
+            return False
+        self.monitor.observe_key(replica_id, meta=replica_id)
+        return True
+
+    def set_draining(self, replica_id: str, draining: bool) -> None:
+        with self._lock:
+            self._replicas[replica_id].draining = bool(draining)
+            self._recount_locked()
+        self.scheduler.kick()
+
+    # -- health state transitions --------------------------------------------
+    def _recount_locked(self) -> None:
+        self._routable_count = sum(
+            1 for r in self._replicas.values() if r.routable)
+
+    def _capacity(self) -> int:
+        # lock-free read (called under the scheduler lock; taking the
+        # router lock here would nest the two in the opposite order of
+        # set_draining -> scheduler.kick)
+        return self._routable_count * self._per_replica
+
+    def _on_replica_dead(self, replica_id: str, _meta: str) -> None:
+        with self._lock:
+            replica = self._replicas.get(replica_id)
+            if replica is None or replica.hb_dead:
+                return
+            replica.hb_dead = True
+            self._recount_locked()
+        _M_EJECTIONS.labels(replica=replica_id, reason="heartbeat").inc()
+        log.warning("fleet: no heartbeat from replica %s for more than "
+                    "%.1fs; ejecting it from routing", replica_id,
+                    self.monitor.timeout)
+        self.scheduler.kick()
+
+    def _on_replica_alive(self, replica_id: str) -> None:
+        with self._lock:
+            replica = self._replicas.get(replica_id)
+            if replica is None or not replica.hb_dead:
+                return
+            replica.hb_dead = False
+            # recovery also wipes the passive signal: the next request's
+            # failure re-opens the circuit if the recovery was illusory
+            replica.circuit_open = False
+            replica.failure_streak = 0
+            self._recount_locked()
+        log.info("fleet: heartbeats from replica %s resumed; re-admitted",
+                 replica_id)
+        self.scheduler.kick()
+
+    def _note_failure(self, replica_id: str) -> None:
+        opened = False
+        with self._lock:
+            replica = self._replicas.get(replica_id)
+            if replica is None:
+                return
+            replica.failure_streak += 1
+            if (replica.failure_streak >= self._circuit_threshold
+                    and not replica.circuit_open):
+                replica.circuit_open = True
+                replica.probe_attempt = 1
+                replica.next_probe_at = time.monotonic() + \
+                    self._probe_policy.backoff(1)
+                self._recount_locked()
+                opened = True
+        if opened:
+            _M_EJECTIONS.labels(replica=replica_id, reason="circuit").inc()
+            log.warning("fleet: replica %s failed %d consecutive requests; "
+                        "circuit opened (half-open probes scheduled)",
+                        replica_id, self._circuit_threshold)
+            self.scheduler.kick()
+
+    def _note_success(self, replica_id: str) -> None:
+        closed = False
+        with self._lock:
+            replica = self._replicas.get(replica_id)
+            if replica is None:
+                return
+            replica.failure_streak = 0
+            if replica.circuit_open:
+                replica.circuit_open = False
+                replica.probe_attempt = 0
+                self._recount_locked()
+                closed = True
+        if closed:
+            log.info("fleet: replica %s recovered; circuit closed",
+                     replica_id)
+            self.scheduler.kick()
+
+    def _probe_loop(self) -> None:
+        while not self._stop_probe.is_set():
+            self._stop_probe.wait(0.05)
+            if self._stop_probe.is_set():
+                return
+            self.probe_now()
+
+    def probe_now(self) -> None:
+        """One half-open sweep: GET /healthz on every circuit-opened
+        replica whose backoff elapsed (callable directly from tests)."""
+        now = time.monotonic()
+        with self._lock:
+            due = [(r.id, r.base_url, r.probe_attempt)
+                   for r in self._replicas.values()
+                   if r.circuit_open and not r.hb_dead
+                   and r.next_probe_at <= now]
+        for replica_id, base_url, attempt in due:
+            try:
+                with urllib.request.urlopen(base_url + "/healthz",
+                                            timeout=self._request_timeout):
+                    pass
+            except Exception:  # noqa: BLE001 — probe failure is the signal
+                with self._lock:
+                    replica = self._replicas.get(replica_id)
+                    if replica is not None and replica.circuit_open:
+                        replica.probe_attempt = attempt + 1
+                        replica.next_probe_at = time.monotonic() + \
+                            self._probe_policy.backoff(attempt + 1)
+                continue
+            self._note_success(replica_id)
+
+    # -- request path --------------------------------------------------------
+    def _pick(self, exclude) -> Optional[_Replica]:
+        """Least-outstanding routable replica (claims one outstanding
+        slot); ``exclude`` holds replica ids already failed this
+        request."""
+        with self._lock:
+            candidates = [r for r in self._replicas.values()
+                          if r.routable and r.id not in exclude]
+            if not candidates:
+                return None
+            replica = min(candidates, key=lambda r: (r.outstanding, r.id))
+            replica.outstanding += 1
+            outstanding = replica.outstanding
+        _M_OUTSTANDING.labels(replica=replica.id).set(outstanding)
+        return replica
+
+    def _done(self, replica: _Replica) -> None:
+        with self._lock:
+            replica.outstanding = max(0, replica.outstanding - 1)
+            outstanding = replica.outstanding
+        _M_OUTSTANDING.labels(replica=replica.id).set(outstanding)
+
+    def _proxy(self, handler: _RouterHandler, path: str) -> None:
+        request_id = handler.headers.get(REQUEST_ID_HEADER) \
+            or uuid.uuid4().hex[:16]
+        try:
+            length = int(handler.headers.get("Content-Length", 0))
+            body = handler.rfile.read(length)
+        except (ValueError, OSError):
+            handler._send(400, {"error": "bad request body"}, request_id)
+            return
+        tenant = self.tenants.resolve(handler.headers)
+        if self._routable_count == 0:
+            # a fully-unroutable fleet fails fast: queueing at zero
+            # capacity would burn the client's deadline to say less
+            log.warning("fleet: request %s (tenant %s): no routable "
+                        "replica", request_id, tenant.name)
+            handler._send(503, {"error": "no routable replicas"},
+                          request_id)
+            return
+        deadline_ts = None
+        deadline_ms = handler.headers.get("X-HVD-TPU-Deadline-Ms")
+        if deadline_ms is None:
+            deadline_ms = _config.live_config().get(
+                _config.SERVING_DEADLINE_MS)
+        try:
+            if float(deadline_ms) > 0:
+                deadline_ts = time.monotonic() + float(deadline_ms) / 1e3
+        except (TypeError, ValueError):
+            pass
+        try:
+            self.scheduler.acquire(tenant, deadline_ts=deadline_ts)
+        except TenantQuotaError as e:
+            handler._send(429, {"error": str(e), "tenant": tenant.name},
+                          request_id)
+            return
+        except DeadlineExceededError as e:
+            handler._send(429, {"error": str(e), "tenant": tenant.name},
+                          request_id)
+            return
+        try:
+            self._forward(handler, path, body, request_id, tenant.name)
+        finally:
+            self.scheduler.release(tenant)
+
+    def _forward(self, handler: _RouterHandler, path: str, body: bytes,
+                 request_id: str, tenant_name: str) -> None:
+        try:
+            _FP_ROUTE.fire()
+        except _faults.InjectedFault as e:
+            log.warning("fleet: request %s (tenant %s) failed at the "
+                        "router: %s", request_id, tenant_name, e)
+            handler._send(503, {"error": f"router fault: {e}"}, request_id)
+            return
+        exclude = set()
+        while True:
+            replica = self._pick(exclude)
+            if replica is None:
+                log.warning("fleet: request %s (tenant %s): no routable "
+                            "replica", request_id, tenant_name)
+                handler._send(503, {"error": "no routable replicas"},
+                              request_id)
+                return
+            req = urllib.request.Request(
+                replica.base_url + path, data=body, method="POST",
+                headers={"Content-Type": "application/json",
+                         REQUEST_ID_HEADER: request_id})
+            try:
+                with urllib.request.urlopen(
+                        req, timeout=self._request_timeout) as resp:
+                    payload, code = resp.read(), resp.status
+            except urllib.error.HTTPError as e:
+                # the replica answered: relay its verdict. 5xx also feeds
+                # the circuit (server sickness); 4xx is the client's own.
+                payload, code = e.read(), e.code
+                if code >= 500:
+                    self._note_failure(replica.id)
+                else:
+                    self._note_success(replica.id)
+                self._done(replica)
+                self._relay(handler, code, payload, request_id)
+                return
+            except Exception as e:  # noqa: BLE001 — connect/read failure
+                self._note_failure(replica.id)
+                self._done(replica)
+                exclude.add(replica.id)
+                log.warning("fleet: request %s: replica %s unreachable "
+                            "(%s); failing over", request_id, replica.id, e)
+                continue
+            self._note_success(replica.id)
+            self._done(replica)
+            self._relay(handler, code, payload, request_id)
+            return
+
+    @staticmethod
+    def _relay(handler: _RouterHandler, code: int, payload: bytes,
+               request_id: str) -> None:
+        _M_REQUESTS.labels(code=str(code)).inc()
+        try:
+            handler.send_response(code)
+            handler.send_header("Content-Type", "application/json")
+            handler.send_header("Content-Length", str(len(payload)))
+            handler.send_header(REQUEST_ID_HEADER, request_id)
+            handler.end_headers()
+            handler.wfile.write(payload)
+        except OSError:
+            handler.close_connection = True
+
+
+class _RouterBeatClient:
+    """KV-client-shaped adapter: a replica's beats become POSTs to the
+    router's heartbeat endpoint. Chaos site ``fleet.health``: an injected
+    error here drops the beat on the floor (the silent-replica
+    simulation) — the sender treats it like any delivery failure."""
+
+    def __init__(self, router_url: str, timeout: float = 2.0):
+        self._url = router_url.rstrip("/")
+        self._timeout = timeout
+
+    def put(self, scope: str, key: str, value: bytes) -> None:
+        _FP_HEALTH.fire()
+        req = urllib.request.Request(
+            self._url + HEARTBEAT_PATH + key, data=value or b"-",
+            method="POST")
+        with urllib.request.urlopen(req, timeout=self._timeout):
+            pass
+
+
+class ReplicaHeartbeat:
+    """Replica-side beat loop: tells the router this replica is alive
+    every ``HVD_TPU_FLEET_HEARTBEAT_INTERVAL`` seconds (the
+    :class:`~horovod_tpu.elastic.heartbeat.HeartbeatSender` loop pointed
+    at the router instead of the rendezvous store)."""
+
+    def __init__(self, router_url: str, replica_id: str,
+                 interval: Optional[float] = None):
+        if interval is None:
+            interval = float(_config.live_config().get(
+                _config.FLEET_HEARTBEAT_INTERVAL))
+        self._sender = HeartbeatSender(
+            _RouterBeatClient(router_url), hostname=replica_id,
+            local_rank=0, rank=replica_id, interval=interval,
+            key=replica_id)
+
+    def beat_once(self) -> bool:
+        return self._sender.beat_once()
+
+    def start(self) -> None:
+        self._sender.start()
+
+    def stop(self) -> None:
+        self._sender.stop()

@@ -2,13 +2,14 @@
 ``/healthz``.
 
 Same stdlib idiom as the rendezvous KV server and the metrics endpoint,
-now through the shared :mod:`horovod_tpu._http` helper: a
-``ThreadingHTTPServer`` with daemon handler threads, quiet logging, and
-idempotent stop. Each connection's handler thread blocks inside
-``engine.infer()`` / ``gen_engine.generate()`` until its work
-completes — the threaded server is what lets N concurrent requests
-coalesce into one forward (inference) or share the running decode
-batch (generation).
+through the shared :mod:`horovod_tpu._http` front-end: the selectors-
+based ``AsyncHTTPServer`` parks idle keep-alive connections in a
+selector (file-descriptor cost only) and drives each active request on
+a worker thread, which blocks inside ``engine.infer()`` /
+``gen_engine.generate()`` until its work completes — so N concurrent
+requests still coalesce into one forward (inference) or share the
+running decode batch (generation), while idle clients no longer hold
+threads.
 
 Admission control shows up at the wire as status codes, identically on
 both POST routes:
@@ -63,7 +64,16 @@ _M_REQUESTS = _metrics.counter(
     labels=("code",))
 
 
+#: cross-tier trace header: the fleet router stamps it (generating one
+#: when the client didn't) and this side echoes it and tags failure logs
+#: with it, so one bad request is greppable router -> replica
+REQUEST_ID_HEADER = "X-HVD-TPU-Request-Id"
+
+
 class _ServingHandler(_http.QuietHandler):
+    def _request_id(self):
+        return self.headers.get(REQUEST_ID_HEADER)
+
     def _respond(self, code: int, doc: dict) -> None:
         body = json.dumps(doc).encode("utf-8")
         _M_REQUESTS.labels(code=str(code)).inc()
@@ -71,6 +81,9 @@ class _ServingHandler(_http.QuietHandler):
             self.send_response(code)
             self.send_header("Content-Type", "application/json")
             self.send_header("Content-Length", str(len(body)))
+            rid = self._request_id()
+            if rid:
+                self.send_header(REQUEST_ID_HEADER, rid)
             self.end_headers()
             self.wfile.write(body)
         except OSError:
@@ -101,12 +114,40 @@ class _ServingHandler(_http.QuietHandler):
             self._infer()
         elif path == "/v1/generate":
             self._generate()
+        elif path == "/v1/reload":
+            self._reload()
         else:
             self._respond(404, {"error": "not found"})
 
     def _read_doc(self):
         length = int(self.headers.get("Content-Length", 0))
-        return json.loads(self.rfile.read(length))
+        raw = self.rfile.read(length)
+        return json.loads(raw) if raw.strip() else {}
+
+    def _reload(self) -> None:
+        """Admin endpoint for the fleet's rolling rollout: swap to the
+        newest committed checkpoint (or an explicit ``{"step": N}``) on
+        whichever engines are configured; response names the serving
+        step afterwards. A failed restore is a 500 with the old params
+        still serving (reload is atomic-or-nothing)."""
+        try:
+            doc = self._read_doc()
+            step = doc.get("step")
+            step = None if step is None else int(step)
+        except (ValueError, TypeError) as e:
+            self._respond(400, {"error": f"bad request: {e}"})
+            return
+        engines = [e for e in (self.server.engine, self.server.gen_engine)
+                   if e is not None]
+        try:
+            reloaded = [bool(e.reload(step=step)) for e in engines]
+        except Exception as e:  # noqa: BLE001 — restore failure -> 500
+            log.warning("serving: reload failed (request %s): %s",
+                        self._request_id(), e)
+            self._respond(500, {"error": str(e)})
+            return
+        self._respond(200, {"reloaded": any(reloaded),
+                            "step": engines[0].step})
 
     def _infer(self) -> None:
         engine: InferenceEngine = self.server.engine
@@ -132,7 +173,8 @@ class _ServingHandler(_http.QuietHandler):
             self._respond(400, {"error": str(e)})
             return
         except Exception as e:  # noqa: BLE001 — forward failure -> 500
-            log.warning("serving: forward failed for one batch: %s", e)
+            log.warning("serving: forward failed for one batch "
+                        "(request %s): %s", self._request_id(), e)
             self._respond(500, {"error": str(e)})
             return
         # step comes back with the batch result: it names the checkpoint
@@ -186,7 +228,8 @@ class _ServingHandler(_http.QuietHandler):
             self._respond(429, {"error": str(e)})
             return
         except Exception as e:  # noqa: BLE001 — decode failure -> 500
-            log.warning("serving: generation failed for one sequence: %s", e)
+            log.warning("serving: generation failed for one sequence "
+                        "(request %s): %s", self._request_id(), e)
             self._respond(500, {"error": str(e)})
             return
         self._respond(200, {"tokens": tokens,
@@ -195,7 +238,7 @@ class _ServingHandler(_http.QuietHandler):
 
 
 class InferenceServer:
-    """Threaded HTTP front-end over an :class:`InferenceEngine` and/or
+    """HTTP front-end over an :class:`InferenceEngine` and/or
     a :class:`~horovod_tpu.serving.generation.GenerationEngine`.
 
     ``engine`` serves ``POST /v1/infer``; ``gen_engine`` serves

@@ -151,6 +151,25 @@ def test_serving_suite_is_seeded_and_exclusive():
     assert os.path.exists(os.path.join(root, "tests", "test_serving.py"))
 
 
+def test_fleet_suite_is_seeded_and_exclusive():
+    """The serving-fleet suite (router health/balancing, per-tenant
+    fair admission, rolling hot-reload, and the fleet.route /
+    fleet.drain / fleet.health chaos drills) runs seeded as its own CI
+    suite; the generic unit and chaos suites must not run the file
+    twice, and the single-replica serving suite stays scoped to its
+    own file."""
+    by_name = {name: cmd for name, cmd, _t in COMMON_SUITES}
+    assert "serving-fleet" in by_name
+    cmd = by_name["serving-fleet"]
+    assert "HVD_TPU_FAULT_SEED=" in cmd
+    assert "tests/test_fleet.py" in cmd
+    assert "--ignore=tests/test_fleet.py" in by_name["unit"]
+    assert "--ignore=tests/test_fleet.py" in by_name["chaos"]
+    assert "tests/test_fleet.py" not in by_name["serving"]
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    assert os.path.exists(os.path.join(root, "tests", "test_fleet.py"))
+
+
 def test_generation_suite_is_seeded_and_exclusive():
     """The continuous-batching generation suite (paged KV cache,
     decode parity, preemption, prefill/decode/evict chaos drills, the

@@ -657,8 +657,14 @@ def test_e2e_heartbeat_timeout_blacklists_silent_worker():
                 "HVD_TPU_FAULT_SPEC": "heartbeat.miss:error:after=2:rank=1",
                 "HVD_TPU_FAULT_SEED": str(SEED),
                 "HVD_TPU_HEARTBEAT_INTERVAL": "1",
-                "HVD_TPU_HEARTBEAT_TIMEOUT": "6",
-                "ELASTIC_TEST_EPOCH_SLEEP": "2.0",
+                # Kill lands at roughly: beats stop (~2s) + timeout +
+                # poll (1s) + SIGTERM grace (5s, the preemption notifier
+                # eats the SIGTERM; SIGKILL is what lands). Epochs must
+                # outlast that comfortably, or a fast rendezvous plane
+                # lets the wedged worker finish before the SIGKILL and
+                # the drill never exercises the blacklist.
+                "HVD_TPU_HEARTBEAT_TIMEOUT": "3",
+                "ELASTIC_TEST_EPOCH_SLEEP": "2.5",
             },
             np_=2, min_np=1, epochs=6, timeout=360)
         code, out = _finish(proc, timeout=360)

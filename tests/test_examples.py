@@ -41,6 +41,7 @@ def _run_example(script, *args, timeout=420, devices=8):
     ("jax_ulysses_long_context.py", ("--seq-len", "256", "--iters", "1")),
     ("jax_checkpoint_resume.py", ()),
     ("jax_serving.py", ("--requests", "8")),
+    ("jax_fleet.py", ("--requests", "12")),
     ("jax_generation.py", ("--max-tokens", "8")),
     ("spark_estimator_train.py", ("--epochs", "2", "--torch-streaming")),
     ("tf2_keras_mnist.py", ("--epochs", "1")),

@@ -1,0 +1,638 @@
+"""Serving-fleet suite (ISSUE 13): replica router health/balancing,
+per-tenant fair admission, rolling hot-reload, and the seeded fleet
+chaos drills.
+
+Run as its own seeded CI suite (``serving-fleet`` in ci/gen_pipeline.py,
+owns this file exclusively). The e2e tests drive real
+:class:`~horovod_tpu.serving.server.InferenceServer` replicas behind a
+live :class:`~horovod_tpu.serving.fleet.FleetRouter`, all on ephemeral
+ports.
+"""
+
+import json
+import threading
+import time
+from urllib.error import HTTPError
+from urllib.request import Request, urlopen
+
+import numpy as np
+import pytest
+
+from horovod_tpu import checkpointing
+from horovod_tpu import faults as F
+from horovod_tpu import metrics as M
+from horovod_tpu import serving
+from horovod_tpu.serving import fleet
+from horovod_tpu.serving.batcher import DeadlineExceededError
+from horovod_tpu.serving.fleet import rollout as fleet_rollout
+from horovod_tpu.serving.fleet.tenancy import FairScheduler, Tenant
+
+SEED = 1234
+
+IN_DIM, OUT_DIM = 4, 2
+
+
+@pytest.fixture(autouse=True)
+def _reset_faults():
+    yield
+    F.configure("", seed=0)
+
+
+def _apply(params, x):
+    return x @ params["w"] + params["b"]
+
+
+def _params(scale: float):
+    """ones(IN_DIM) @ w -> full(OUT_DIM, 4*scale): the serving
+    checkpoint version is readable off any output."""
+    return {"w": np.full((IN_DIM, OUT_DIM), scale, np.float32),
+            "b": np.zeros(OUT_DIM, np.float32)}
+
+
+def _engine(tmp_path=None, params=None, **kw):
+    kw.setdefault("max_batch", 8)
+    kw.setdefault("batch_timeout_ms", 2.0)
+    kw.setdefault("deadline_ms", 0)
+    kw.setdefault("reload_poll_seconds", 0)
+    kw.setdefault("warmup", False)
+    return serving.InferenceEngine(
+        _apply, checkpoint_dir=str(tmp_path) if tmp_path else None,
+        params=params, **kw)
+
+
+def _replica(tmp_path=None, params=None, **kw):
+    srv = serving.InferenceServer(_engine(tmp_path, params, **kw),
+                                  port=0, addr="127.0.0.1")
+    srv.start()
+    return srv
+
+
+def _post(url, doc=None, headers=None, timeout=30):
+    body = json.dumps(doc if doc is not None
+                      else {"inputs": [[1.0] * IN_DIM]}).encode()
+    req = Request(url, data=body, method="POST",
+                  headers={"Content-Type": "application/json",
+                           **(headers or {})})
+    try:
+        with urlopen(req, timeout=timeout) as resp:
+            return resp.status, json.loads(resp.read()), dict(resp.headers)
+    except HTTPError as e:
+        return e.code, json.loads(e.read() or b"{}"), dict(e.headers)
+
+
+def _delta(before, key):
+    return M.snapshot().get(key, 0) - before.get(key, 0)
+
+
+def _series(snap, name, **labels):
+    """The one series of ``name`` whose labels include ``labels``."""
+    hits = [v for k, v in snap.items()
+            if k.startswith(name)
+            and all(f'{n}="{v_}"' in k for n, v_ in labels.items())]
+    assert len(hits) <= 1, hits
+    return hits[0] if hits else None
+
+
+def _router(replicas, **kw):
+    kw.setdefault("addr", "127.0.0.1")
+    kw.setdefault("heartbeat_timeout", 0.5)
+    kw.setdefault("heartbeat_interval", 0.1)
+    r = fleet.FleetRouter(replicas, port=0, **kw)
+    r.start()
+    return r
+
+
+# ---------------------------------------------------------------------------
+# tenancy: registry + fair scheduler (in-process)
+# ---------------------------------------------------------------------------
+
+class TestTenantRegistry:
+    SPEC = json.dumps({
+        "gold": {"keys": ["k-gold"], "max_concurrent": 8, "weight": 4,
+                 "priority": 1},
+        "free": {"keys": ["k-free1", "k-free2"], "max_queued": 2}})
+
+    def test_resolution_order(self):
+        reg = fleet.TenantRegistry(spec=self.SPEC)
+        assert reg.resolve({fleet.API_KEY_HEADER: "k-gold"}).name == "gold"
+        assert reg.resolve({fleet.API_KEY_HEADER: "k-free2"}).name == "free"
+        # explicit tenant header works for configured tenants only
+        assert reg.resolve({fleet.TENANT_HEADER: "gold"}).name == "gold"
+        assert reg.resolve({fleet.TENANT_HEADER: "nope"}).name == "default"
+        # unknown key falls through to the header, then default
+        assert reg.resolve({fleet.API_KEY_HEADER: "bogus"}).name == "default"
+        assert reg.resolve({}).name == "default"
+
+    def test_spec_overrides_and_defaults(self):
+        reg = fleet.TenantRegistry(spec=self.SPEC)
+        gold = reg.get("gold")
+        assert (gold.max_concurrent, gold.weight, gold.priority) == (8, 4, 1)
+        assert reg.get("free").max_queued == 2
+        # the built-in default tenant always exists
+        assert reg.get("default").name == "default"
+
+
+class TestFairScheduler:
+    def test_quota_rejects_immediately_when_queue_full(self):
+        sched = FairScheduler(capacity_fn=lambda: 0)   # nothing dispatches
+        t = Tenant("t", max_queued=2)
+        waiters = [threading.Thread(
+            target=lambda: pytest.raises(Exception, sched.acquire, t,
+                                         time.monotonic() + 5),
+            daemon=True) for _ in range(2)]
+        for w in waiters:
+            w.start()
+        deadline = time.monotonic() + 5
+        while sched.stats().get("t", {}).get("queued") != 2:
+            assert time.monotonic() < deadline
+            time.sleep(0.01)
+        t0 = time.monotonic()
+        with pytest.raises(fleet.TenantQuotaError):
+            sched.acquire(t)
+        assert time.monotonic() - t0 < 1.0, "quota rejection must not queue"
+        sched.close()
+
+    def test_deadline_expires_in_queue(self):
+        sched = FairScheduler(capacity_fn=lambda: 0)
+        with pytest.raises(DeadlineExceededError):
+            sched.acquire(Tenant("t"), deadline_ts=time.monotonic() + 0.2)
+        sched.close()
+
+    def test_weighted_fair_dequeue_ratio(self):
+        """Under contention a weight-2 tenant dispatches ~2x a weight-1
+        tenant: serve one grant at a time and count the first grants."""
+        cap = {"v": 0}      # gate: everyone queues before any grant
+        sched = FairScheduler(capacity_fn=lambda: cap["v"])
+        heavy = Tenant("heavy", weight=2.0, max_concurrent=64,
+                       max_queued=64)
+        light = Tenant("light", weight=1.0, max_concurrent=64,
+                       max_queued=64)
+        order = []
+        lock = threading.Lock()
+
+        def one(tenant):
+            sched.acquire(tenant, deadline_ts=time.monotonic() + 30)
+            with lock:
+                order.append(tenant.name)
+            sched.release(tenant)
+
+        threads = [threading.Thread(target=one, args=(t,), daemon=True)
+                   for t in [heavy] * 20 + [light] * 20]
+        for th in threads:
+            th.start()
+        deadline = time.monotonic() + 10
+        while sum(s["queued"] for s in sched.stats().values()) < 40:
+            assert time.monotonic() < deadline, sched.stats()
+            time.sleep(0.01)
+        cap["v"] = 1        # one grant at a time: pure stride order
+        sched.kick()
+        for th in threads:
+            th.join(timeout=30)
+            assert not th.is_alive()
+        first = order[:12]
+        assert 6 <= first.count("heavy") <= 10, order
+        sched.close()
+
+    def test_priority_class_preempts_weights(self):
+        sched = FairScheduler(capacity_fn=lambda: 1)
+        low = Tenant("low", weight=100.0, max_queued=64)
+        high = Tenant("high", priority=1, max_queued=64)
+        # hold the only slot so both tenants must queue behind it
+        holder = Tenant("holder")
+        sched.acquire(holder)
+        order = []
+        lock = threading.Lock()
+
+        def one(tenant):
+            sched.acquire(tenant, deadline_ts=time.monotonic() + 30)
+            with lock:
+                order.append(tenant.name)
+            sched.release(tenant)
+
+        threads = [threading.Thread(target=one, args=(t,), daemon=True)
+                   for t in [low] * 4 + [high] * 4]
+        for th in threads:
+            th.start()
+        deadline = time.monotonic() + 5
+        while sum(s["queued"] for s in sched.stats().values()) < 8:
+            assert time.monotonic() < deadline
+            time.sleep(0.01)
+        sched.release(holder)
+        for th in threads:
+            th.join(timeout=30)
+            assert not th.is_alive()
+        assert order[:4] == ["high"] * 4, order
+        sched.close()
+
+
+# ---------------------------------------------------------------------------
+# router e2e: balancing, health, request ids
+# ---------------------------------------------------------------------------
+
+class TestRouterE2E:
+    def test_kill_replica_ejected_within_2x_timeout_survivor_serves(self):
+        """The acceptance drill: two live replicas, one goes silent
+        (server down, beats stop) — the router ejects it within 2x the
+        heartbeat timeout while a client hammering the router sees only
+        200s."""
+        r0, r1 = _replica(params=_params(1.0)), _replica(params=_params(1.0))
+        router = _router({"r0": f"http://127.0.0.1:{r0.port}",
+                          "r1": f"http://127.0.0.1:{r1.port}"})
+        hb0 = fleet.ReplicaHeartbeat(router.url, "r0", interval=0.1)
+        hb1 = fleet.ReplicaHeartbeat(router.url, "r1", interval=0.1)
+        failures, stop = [], threading.Event()
+
+        def client():
+            while not stop.is_set():
+                code, doc, _ = _post(router.url + "/v1/infer")
+                if code != 200:
+                    failures.append((code, doc))
+                time.sleep(0.01)
+
+        try:
+            hb0.start(), hb1.start()
+            threads = [threading.Thread(target=client, daemon=True)
+                       for _ in range(3)]
+            for t in threads:
+                t.start()
+            time.sleep(0.4)      # both armed, traffic flowing
+            # kill r1: server gone, beats gone
+            hb1.stop()
+            r1.stop()
+            t_kill = time.monotonic()
+            while True:
+                doc = router.health_doc()
+                if doc["replicas"]["r1"]["state"] == "dead":
+                    break
+                assert time.monotonic() - t_kill < 2 * 0.5, doc
+                time.sleep(0.02)
+            time.sleep(0.3)      # survivor-only traffic
+            stop.set()
+            for t in threads:
+                t.join(timeout=10)
+            assert failures == [], failures[:5]
+            assert router.routable_count() == 1
+            code, _, _ = _post(router.url + "/v1/infer")
+            assert code == 200
+        finally:
+            stop.set()
+            hb0.stop(), hb1.stop()
+            router.stop()
+            r0.close(), r1.close()
+
+    def test_circuit_opens_on_connect_errors_and_probes_reclose(self):
+        """Passive health: a replica that was never armed by heartbeats
+        still gets ejected after a connect-error streak, and the
+        half-open /healthz probe re-admits it when it comes back."""
+        good = _replica(params=_params(1.0))
+        # reserve a port that refuses connections, then use it for "bad"
+        import socket
+        probe = socket.socket()
+        probe.bind(("127.0.0.1", 0))
+        dead_port = probe.getsockname()[1]
+        probe.close()
+        router = _router({"good": f"http://127.0.0.1:{good.port}",
+                          "bad": f"http://127.0.0.1:{dead_port}"})
+        try:
+            # every request still answers 200 (failover), while bad's
+            # streak builds to the circuit threshold (3)
+            for _ in range(6):
+                code, _, _ = _post(router.url + "/v1/infer")
+                assert code == 200
+            deadline = time.monotonic() + 5
+            while router.health_doc()["replicas"]["bad"]["state"] \
+                    != "circuit_open":
+                assert time.monotonic() < deadline, router.health_doc()
+                _post(router.url + "/v1/infer")
+                time.sleep(0.02)
+            # resurrect "bad" as a live server on the same port
+            revived = serving.InferenceServer(
+                _engine(params=_params(1.0)), port=dead_port,
+                addr="127.0.0.1")
+            revived.start()
+            try:
+                deadline = time.monotonic() + 10
+                while router.health_doc()["replicas"]["bad"]["state"] \
+                        != "up":
+                    assert time.monotonic() < deadline, router.health_doc()
+                    time.sleep(0.05)
+            finally:
+                revived.close()
+        finally:
+            router.stop()
+            good.close()
+
+    def test_request_id_stamped_and_propagated(self):
+        srv = _replica(params=_params(1.0))
+        router = _router({"r0": f"http://127.0.0.1:{srv.port}"})
+        try:
+            # client-supplied id comes back on the router response
+            code, _, headers = _post(
+                router.url + "/v1/infer",
+                headers={fleet.REQUEST_ID_HEADER: "req-abc123"})
+            assert code == 200
+            assert headers.get(fleet.REQUEST_ID_HEADER) == "req-abc123"
+            # no id: the router mints one
+            code, _, headers = _post(router.url + "/v1/infer")
+            assert code == 200
+            assert headers.get(fleet.REQUEST_ID_HEADER)
+            # the replica echoes the forwarded id on its own response
+            code, _, headers = _post(
+                f"http://127.0.0.1:{srv.port}/v1/infer",
+                headers={fleet.REQUEST_ID_HEADER: "req-direct"})
+            assert code == 200
+            assert headers.get(fleet.REQUEST_ID_HEADER) == "req-direct"
+        finally:
+            router.stop()
+            srv.close()
+
+    def test_least_outstanding_prefers_idle_replica(self):
+        r0, r1 = _replica(params=_params(1.0)), _replica(params=_params(1.0))
+        router = _router({"r0": f"http://127.0.0.1:{r0.port}",
+                          "r1": f"http://127.0.0.1:{r1.port}"})
+        try:
+            before = M.snapshot()
+            for _ in range(10):
+                code, _, _ = _post(router.url + "/v1/infer")
+                assert code == 200
+            # sequential requests always see both replicas idle: the
+            # id tiebreak pins them to r0, proving the count (not
+            # round-robin) drives selection; and the outstanding gauge
+            # is back to 0 for every replica afterwards
+            assert _delta(before,
+                          'hvd_tpu_fleet_requests_total{code="200"}') >= 10
+            snap = M.snapshot()
+            assert _series(snap, "hvd_tpu_fleet_outstanding",
+                           replica="r0") == 0
+            assert _series(snap, "hvd_tpu_fleet_outstanding",
+                           replica="r1") == 0
+        finally:
+            router.stop()
+            r0.close(), r1.close()
+
+
+# ---------------------------------------------------------------------------
+# tenant fairness through the live router
+# ---------------------------------------------------------------------------
+
+class TestTenantFairness:
+    TENANTS = json.dumps({
+        "good": {"keys": ["key-good"], "max_concurrent": 2,
+                 "max_queued": 8},
+        "flood": {"keys": ["key-flood"], "max_concurrent": 2,
+                  "max_queued": 4}})
+
+    def test_flooding_tenant_gets_only_its_own_429s(self, monkeypatch):
+        """A tenant offering 10x its queue cap eats quota 429s; the
+        well-behaved tenant sees zero rejections and a bounded p100
+        queue wait (read off the fairness histogram)."""
+        monkeypatch.setenv("HVD_TPU_FLEET_REPLICA_CONCURRENCY", "2")
+        srv = _replica(params=_params(1.0))
+        registry = fleet.TenantRegistry(spec=self.TENANTS)
+        router = _router({"r0": f"http://127.0.0.1:{srv.port}"},
+                         tenants=registry)
+        before = M.snapshot()
+        flood_codes, good_codes = [], []
+        lock = threading.Lock()
+        stop = threading.Event()
+        deadline_hdr = {"X-HVD-TPU-Deadline-Ms": "30000"}
+
+        def flood():
+            while not stop.is_set():
+                code, _, _ = _post(
+                    router.url + "/v1/infer",
+                    headers={fleet.API_KEY_HEADER: "key-flood",
+                             **deadline_hdr})
+                with lock:
+                    flood_codes.append(code)
+
+        def good():
+            for _ in range(25):
+                code, _, _ = _post(
+                    router.url + "/v1/infer",
+                    headers={fleet.API_KEY_HEADER: "key-good",
+                             **deadline_hdr})
+                with lock:
+                    good_codes.append(code)
+                time.sleep(0.005)
+
+        try:
+            # 40 concurrent flooders against max_queued=4: 10x quota
+            flooders = [threading.Thread(target=flood, daemon=True)
+                        for _ in range(40)]
+            for t in flooders:
+                t.start()
+            good_t = threading.Thread(target=good, daemon=True)
+            good_t.start()
+            good_t.join(timeout=120)
+            assert not good_t.is_alive()
+            stop.set()
+            for t in flooders:
+                t.join(timeout=30)
+                assert not t.is_alive()
+        finally:
+            stop.set()
+            router.stop()
+            srv.close()
+        # the flood was actually rejected — and only the flood
+        assert flood_codes.count(429) > 0
+        assert good_codes == [200] * 25, good_codes
+        snap = M.snapshot()
+        flood_rej = (_series(snap, "hvd_tpu_fleet_tenant_rejected_total",
+                             tenant="flood", reason="quota") or 0) - \
+            (_series(before, "hvd_tpu_fleet_tenant_rejected_total",
+                     tenant="flood", reason="quota") or 0)
+        good_rej = sum(
+            v for k, v in snap.items()
+            if k.startswith("hvd_tpu_fleet_tenant_rejected_total")
+            and 'tenant="good"' in k) - sum(
+            v for k, v in before.items()
+            if k.startswith("hvd_tpu_fleet_tenant_rejected_total")
+            and 'tenant="good"' in k)
+        assert flood_rej > 0 and flood_rej == flood_codes.count(429)
+        assert good_rej == 0
+        # p100 queue wait for the good tenant, from the histogram: the
+        # largest bucket needed to cover every observation stays small
+        # even while the flood queues 10x capacity
+        hist = _series(snap, "hvd_tpu_fleet_tenant_queue_wait_seconds",
+                       tenant="good")
+        assert hist is not None and hist["count"] >= 25
+        p100 = min(float(le) for le, n in hist["buckets"].items()
+                   if n >= hist["count"])
+        assert p100 <= 2.5, (p100, hist)
+
+
+# ---------------------------------------------------------------------------
+# rolling hot-reload
+# ---------------------------------------------------------------------------
+
+class TestRollingReload:
+    def _fleet(self, tmp_path, n=2):
+        replicas, urls = [], {}
+        for i in range(n):
+            ckpt = tmp_path / f"replica{i}"
+            ckpt.mkdir()
+            checkpointing.save(str(ckpt), 1, _params(1.0))
+            srv = _replica(ckpt)
+            replicas.append(srv)
+            urls[f"r{i}"] = f"http://127.0.0.1:{srv.port}"
+            checkpointing.save(str(ckpt), 2, _params(2.0))
+        return replicas, urls
+
+    def test_rolling_reload_mid_traffic_zero_failures(self, tmp_path,
+                                                      monkeypatch):
+        """The acceptance drill: clients loop against the router while
+        every replica is drained, swapped to step 2 and verified —
+        zero failed requests, and each swap only fires once the
+        draining replica's outstanding gauge reached 0."""
+        replicas, urls = self._fleet(tmp_path)
+        router = _router(urls)
+        failures, seen = [], []
+        stop = threading.Event()
+        gauge_at_swap = []
+        real_post_reload = fleet_rollout._post_reload
+
+        def checked_post_reload(base_url, step, timeout):
+            rid = [i for i, u in urls.items() if u == base_url][0]
+            snap = M.snapshot()
+            gauge_at_swap.append(
+                (rid, router.outstanding(rid),
+                 _series(snap, "hvd_tpu_fleet_outstanding", replica=rid)))
+            return real_post_reload(base_url, step, timeout)
+
+        monkeypatch.setattr(fleet_rollout, "_post_reload",
+                            checked_post_reload)
+
+        def client():
+            while not stop.is_set():
+                code, doc, _ = _post(router.url + "/v1/infer")
+                if code != 200:
+                    failures.append((code, doc))
+                else:
+                    seen.append((doc["step"],
+                                 float(np.asarray(doc["outputs"])[0, 0])))
+                time.sleep(0.002)
+
+        try:
+            threads = [threading.Thread(target=client, daemon=True)
+                       for _ in range(4)]
+            for t in threads:
+                t.start()
+            time.sleep(0.2)
+            summary = fleet.rolling_reload(router, step=2,
+                                           drain_deadline=10.0)
+            time.sleep(0.2)
+            stop.set()
+            for t in threads:
+                t.join(timeout=10)
+            for url in urls.values():
+                with urlopen(url + "/healthz", timeout=10) as resp:
+                    assert json.loads(resp.read())["step"] == 2
+        finally:
+            stop.set()
+            router.stop()
+            for srv in replicas:
+                srv.close()
+        assert failures == [], failures[:5]
+        assert summary == {"result": "ok", "replicas": ["r0", "r1"],
+                           "step": 2}
+        # every swap waited for a fully drained replica
+        assert [g[0] for g in gauge_at_swap] == ["r0", "r1"]
+        assert all(out == 0 and gauge == 0
+                   for _, out, gauge in gauge_at_swap), gauge_at_swap
+        # traffic only ever saw committed checkpoints, and the fleet
+        # ended on the new one
+        assert all(val == (4.0 if step == 1 else 8.0)
+                   for step, val in seen), seen[-5:]
+        assert seen[-1][0] == 2, seen[-5:]
+
+
+# ---------------------------------------------------------------------------
+# seeded chaos drills (fault sites owned by this subsystem)
+# ---------------------------------------------------------------------------
+
+class TestFleetChaos:
+    def test_drill_route_fault_answers_503_then_recovers(self):
+        """``fleet.route:error:once``: the injected router fault is a
+        503 without touching any replica; the next request is served."""
+        srv = _replica(params=_params(1.0))
+        router = _router({"r0": f"http://127.0.0.1:{srv.port}"})
+        before = M.snapshot()
+        try:
+            F.configure("fleet.route:error:once", seed=SEED)
+            code, doc, _ = _post(router.url + "/v1/infer")
+            assert code == 503 and "router fault" in doc["error"]
+            code, _, _ = _post(router.url + "/v1/infer")
+            assert code == 200
+        finally:
+            router.stop()
+            srv.close()
+        assert _delta(before,
+                      'hvd_tpu_fleet_requests_total{code="503"}') == 1
+
+    def test_drill_drain_wedge_aborts_rollout_and_readmits(self, tmp_path):
+        """``fleet.drain:error``: the drain never completes, the
+        deadline aborts the rollout, the replica is re-admitted
+        un-swapped and keeps serving the old step."""
+        ckpt = tmp_path / "replica0"
+        ckpt.mkdir()
+        checkpointing.save(str(ckpt), 1, _params(1.0))
+        srv = _replica(ckpt)
+        checkpointing.save(str(ckpt), 2, _params(2.0))
+        router = _router({"r0": f"http://127.0.0.1:{srv.port}"})
+        before = M.snapshot()
+        try:
+            F.configure("fleet.drain:error", seed=SEED)
+            t0 = time.monotonic()
+            with pytest.raises(fleet.RolloutAborted):
+                fleet.rolling_reload(router, step=2, drain_deadline=0.3)
+            assert time.monotonic() - t0 < 5.0, \
+                "the drain deadline, not the fault, must bound the abort"
+            F.configure("", seed=0)
+            # fail-static: re-admitted, routable, still on the old step
+            doc = router.health_doc()
+            assert doc["replicas"]["r0"]["state"] == "up"
+            code, served, _ = _post(router.url + "/v1/infer")
+            assert code == 200 and served["step"] == 1
+        finally:
+            router.stop()
+            srv.close()
+        assert _delta(
+            before,
+            'hvd_tpu_fleet_rollouts_total{result="aborted"}') == 1
+
+    def test_drill_dropped_beats_eject_then_readmit(self):
+        """``fleet.health:error:after=2``: two beats arm the replica,
+        then delivery fails — the router ejects it within 2x the
+        heartbeat timeout and re-admits it when beats resume."""
+        srv = _replica(params=_params(1.0))
+        router = _router({"r0": f"http://127.0.0.1:{srv.port}"})
+        hb = fleet.ReplicaHeartbeat(router.url, "r0", interval=0.1)
+        before = M.snapshot()
+        try:
+            F.configure("fleet.health:error:after=2", seed=SEED)
+            assert hb.beat_once() and hb.beat_once()     # armed
+            assert not hb.beat_once()                    # dropped
+            t0 = time.monotonic()
+            while router.health_doc()["replicas"]["r0"]["state"] != "dead":
+                assert time.monotonic() - t0 < 2 * 0.5, router.health_doc()
+                hb.beat_once()                           # still dropped
+                time.sleep(0.02)
+            # dead fleet: the router answers its own 503, no replica seen
+            code, doc, _ = _post(router.url + "/v1/infer")
+            assert code == 503 and "no routable" in doc["error"]
+            F.configure("", seed=0)
+            assert hb.beat_once()                        # delivery resumes
+            deadline = time.monotonic() + 5
+            while router.health_doc()["replicas"]["r0"]["state"] != "up":
+                assert time.monotonic() < deadline, router.health_doc()
+                time.sleep(0.02)
+            code, _, _ = _post(router.url + "/v1/infer")
+            assert code == 200
+        finally:
+            router.stop()
+            srv.close()
+        assert _delta(
+            before,
+            'hvd_tpu_fleet_ejections_total{replica="r0",'
+            'reason="heartbeat"}') == 1
