@@ -303,6 +303,29 @@ class CheckpointManager:
         self._queue.join()
         self._raise_pending()
 
+    def drain_for_preemption(self, step: Optional[int] = None,
+                             tree: Any = None) -> Optional[int]:
+        """Preemption-notice drain: finish every in-flight save, then —
+        when the caller supplies its current ``(step, tree)`` and the
+        newest committed step is older — force one final *synchronous*
+        save, so the grace window is spent persisting progress instead of
+        re-running it after the handoff. A save already in flight (or
+        committed) for ``step`` is drained, never duplicated: the
+        in-flight copy lands via ``wait_until_finished`` and the stale
+        check then sees it committed. Returns the newest committed step
+        (None when the directory holds none)."""
+        self.wait_until_finished()
+        if step is not None and tree is not None:
+            latest = self.latest_step()
+            if latest is None or latest < step:
+                try:
+                    self.save(step, tree, async_=False)
+                except FileExistsError:
+                    # landed between the check and the save (another
+                    # writer/process): already durable, nothing to do
+                    pass
+        return self.latest_step()
+
     def close(self) -> None:
         """Drain and stop the writer thread (managers are reusable after
         close — the next async save restarts the writer)."""

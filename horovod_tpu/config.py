@@ -282,6 +282,23 @@ HEARTBEAT_TIMEOUT = _register(
          "timeout + one monitor poll (< 2x this value). Only armed once "
          "a worker's first beat arrives, and cleared per generation, so "
          "slow startups and re-execs are never misdeclared.")
+ELASTIC_SCALE_UP_DELAY = _register(
+    "ELASTIC_SCALE_UP_DELAY", 0.0, float,
+    help="Seconds a grow-only membership delta must persist across "
+         "discovery polls before the elastic driver interrupts the "
+         "running generation to grow into the new capacity — the "
+         "debounce that keeps one flapping discovery poll from "
+         "triggering a resize. 0 (default) grows on the first poll "
+         "(the pre-policy behavior). Shrinks (host lost or draining) "
+         "always interrupt immediately.")
+ELASTIC_SCALE_DOWN_POLICY = _register(
+    "ELASTIC_SCALE_DOWN_POLICY", "drain", str,
+    help="How the elastic driver handles a preemption notice: 'drain' "
+         "(default) gracefully retires the host — final commit flushed, "
+         "heartbeat tracking dropped, survivors re-rendezvous and "
+         "restore its shards via resharding, host stays re-admittable — "
+         "while 'immediate' fires the legacy kill path (host event -> "
+         "worker exit -> FAILURE -> blacklist).")
 
 # -- Consistency checking (replaces the reference controller's per-cycle
 #    dtype/shape validation, controller.cc:378-611) --------------------------

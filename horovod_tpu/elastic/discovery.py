@@ -22,11 +22,19 @@ _FP_DISCOVERY = _faults.FaultPoint("elastic.discovery", exc=RuntimeError)
 class HostState:
     """Per-host liveness: an event that fires when the host changes or is
     blacklisted (workers started on that host watch it), plus the blacklist
-    flag (reference discovery.py:25-46)."""
+    flag (reference discovery.py:25-46) and a *draining* flag.
+
+    Draining is deliberately NOT blacklisting: a draining host is excluded
+    from new assignments (so the next generation forms without it) but its
+    in-flight worker must still be treated as healthy — the registry
+    barrier skips blacklisted hosts' READY records, so conflating the two
+    would hang the old generation's barrier, and a drained host must stay
+    re-admittable once capacity returns."""
 
     def __init__(self):
         self._event = threading.Event()
         self._blacklisted = False
+        self._draining = False
 
     def get_event(self) -> threading.Event:
         if self._event.is_set():
@@ -44,6 +52,17 @@ class HostState:
 
     def is_blacklisted(self) -> bool:
         return self._blacklisted
+
+    def mark_draining(self) -> None:
+        # no set_event(): the draining worker keeps running through its
+        # grace window; the re-rendezvous (not a kill) retires it
+        self._draining = True
+
+    def clear_draining(self) -> None:
+        self._draining = False
+
+    def is_draining(self) -> bool:
+        return self._draining
 
 
 class DiscoveredHosts:
@@ -67,9 +86,14 @@ class DiscoveredHosts:
 
     def drop_blacklisted(self, states: Dict[str, HostState]
                          ) -> "DiscoveredHosts":
+        # Draining hosts are dropped alongside blacklisted ones: both are
+        # excluded from slot counts and new assignments — but a draining
+        # host's state flag is cleared once its drain completes, so it
+        # reappears here on the next discovery poll (re-admission).
         self.host_assignment_order = [
             h for h in self.host_assignment_order
-            if not (h in states and states[h].is_blacklisted())]
+            if not (h in states and (states[h].is_blacklisted()
+                                     or states[h].is_draining()))]
         return self
 
 
@@ -77,6 +101,14 @@ class HostDiscovery:
     def find_available_hosts_and_slots(self) -> Dict[str, int]:
         """Return {hostname: slots} for every currently usable host."""
         raise NotImplementedError
+
+    def find_preempted_hosts(self) -> Dict[str, float]:
+        """Return {hostname: grace_seconds} for hosts the fleet scheduler
+        has announced it will reclaim. Polled by the driver's discovery
+        loop each cycle; notices are routed into the same graceful-drain
+        path as the ``preempt`` scope and fault kind. Default: none —
+        subclasses with a cloud-metadata or scheduler API override this."""
+        return {}
 
 
 class HostDiscoveryScript(HostDiscovery):
@@ -163,10 +195,33 @@ class HostManager:
 
     @property
     def current_hosts(self) -> DiscoveredHosts:
-        return self._current.drop_blacklisted(self._states)
+        # Filter a fresh snapshot, not the stored one: drop_blacklisted
+        # mutates host_assignment_order in place, and a draining host must
+        # reappear in the order (same discovery data) once its drain
+        # completes and clear_draining runs — an in-place drop would make
+        # the exclusion permanent until the host set itself changed.
+        snapshot = DiscoveredHosts(self._current.host_slots,
+                                   self._current.host_assignment_order)
+        return snapshot.drop_blacklisted(self._states)
 
     def blacklist(self, host: str) -> None:
         self._state(host).blacklist()
+
+    def mark_draining(self, host: str) -> None:
+        """Exclude ``host`` from new assignments without blacklisting it
+        (graceful preemption drain — see :class:`HostState`)."""
+        self._state(host).mark_draining()
+
+    def clear_draining(self, host: str) -> None:
+        """Drain finished (or cancelled): the host is re-admittable on the
+        next ``current_hosts`` access if discovery still reports it."""
+        self._state(host).clear_draining()
+
+    def is_draining(self, host: str) -> bool:
+        return host in self._states and self._states[host].is_draining()
+
+    def draining_hosts(self) -> List[str]:
+        return [h for h, s in self._states.items() if s.is_draining()]
 
     def fire_host_event(self, host: str) -> None:
         """Fire the host's change event WITHOUT blacklisting it — how the

@@ -21,12 +21,16 @@ from .. import metrics as _metrics
 from ..runner.hosts import HostInfo, SlotInfo, get_host_assignments
 from .discovery import DiscoveredHosts, HostManager
 from .heartbeat import HeartbeatMonitor
+from .preemption import PREEMPT_SCOPE, decode_notice, encode_notice
 from .registration import WorkerStateRegistry
 from .worker import PUT_WORKER_ADDRESSES, WorkerNotificationClient
 
 #: rendezvous scope persisting blacklisted hostnames — journaled with the
 #: rest of the store, so a restarted coordinator does not re-run doomed
-#: hosts it already learned about
+#: hosts it already learned about. Values are the blacklist *reason*
+#: (b"failure", ...); gracefully-drained hosts are never written here —
+#: their durable record lives in the ``preempt`` scope instead, and is
+#: deleted when the drain completes.
 BLACKLIST_SCOPE = "blacklist"
 
 # Elastic membership events as counters: a flapping host shows up as a
@@ -42,6 +46,21 @@ _M_RANK_ADDED = _metrics.counter(
 _M_RANK_REMOVED = _metrics.counter(
     "hvd_tpu_elastic_rank_removed_total",
     "Worker slots removed relative to the previous elastic generation.")
+_M_PREEMPTIONS = _metrics.counter(
+    "hvd_tpu_elastic_preemptions_total",
+    "Preemption notices processed by the elastic driver, by outcome: "
+    "'drained' (graceful drain completed), 'immediate' (scale-down policy "
+    "killed the host instead of draining).",
+    labels=("outcome",))
+_M_DRAIN_SECONDS = _metrics.histogram(
+    "hvd_tpu_elastic_drain_seconds",
+    "Wall time from a preemption notice to the drained host leaving the "
+    "generation (final commit drained, survivors re-rendezvoused).")
+_M_SCALE_EVENTS = _metrics.counter(
+    "hvd_tpu_elastic_scale_events_total",
+    "Deliberate elastic resizes, by direction: 'up' (debounced growth "
+    "into new capacity), 'down' (preemption-notice shrink).",
+    labels=("direction",))
 
 DISCOVER_HOSTS_FREQUENCY_SECS = 1.0
 
@@ -113,14 +132,27 @@ class ElasticDriver:
                  timeout: Optional[float] = None,
                  reset_limit: Optional[int] = None):
         self._rendezvous = rendezvous
+        self._discovery = discovery
         self._host_manager = HostManager(discovery)
         self._min_np = min_np
         self._max_np = max_np
         # resolved through the knob registry (HVD_TPU_ELASTIC_TIMEOUT /
         # HOROVOD_ELASTIC_TIMEOUT alias / default) so the launcher-side
         # driver and the documented config table can never disagree
-        self._timeout = timeout or float(
-            _config.Config().get(_config.ELASTIC_TIMEOUT))
+        cfg = _config.Config()
+        self._timeout = timeout or float(cfg.get(_config.ELASTIC_TIMEOUT))
+        # Policy knobs: growth waits out flapping discovery before a
+        # resize; shrink either drains (default) or kills (legacy).
+        self._scale_up_delay = float(
+            cfg.get(_config.ELASTIC_SCALE_UP_DELAY))
+        self._scale_down_policy = str(
+            cfg.get(_config.ELASTIC_SCALE_DOWN_POLICY)).strip().lower()
+        #: host -> {"grace": s, "ts": notice unix time, "start": monotonic}
+        #: for in-flight graceful drains (host also flagged in HostManager)
+        self._draining: Dict[str, dict] = {}
+        #: monotonic time a grow-only membership delta was first seen
+        #: (scale-up debounce anchor); None when no growth is pending
+        self._scaleup_since: Optional[float] = None
 
         self._host_assignments: Dict[str, List[SlotInfo]] = {}
         self._rank_assignments: Dict[int, SlotInfo] = {}
@@ -203,6 +235,13 @@ class ElasticDriver:
     # -- liveness / blacklist ------------------------------------------------
     def record_heartbeat(self, key: str, value: bytes) -> None:
         """PUT handler for the ``heartbeat`` scope (elastic/rendezvous.py)."""
+        host, _, _ = key.rpartition(":")
+        if host and self._host_manager.is_draining(host):
+            # A draining host's sender may still be beating through its
+            # grace window; observing it would re-arm the slot the drain
+            # already forgot, and its eventual (expected) silence would
+            # then tick the miss counter and fire a spurious timeout.
+            return
         self._heartbeat_monitor.observe(key, value)
 
     def _on_heartbeat_timeout(self, host: str, slot: int, rank) -> None:
@@ -213,30 +252,130 @@ class ElasticDriver:
         # host on the barrier — one recovery path for every death signal.
         self._host_manager.fire_host_event(host)
 
-    def blacklist_host(self, host: str) -> None:
-        """Blacklist ``host`` and persist the fact to the rendezvous so a
-        journal-restarted coordinator re-seeds it (restore_from_rendezvous)
-        instead of re-running a host it already knows is bad."""
+    def blacklist_host(self, host: str, reason: str = "failure") -> None:
+        """Exclude ``host`` from assignment, by ``reason``:
+
+        * ``"failure"`` (default, and what the registry's barrier uses):
+          hard blacklist, persisted to the journaled ``blacklist`` scope so
+          a journal-restarted coordinator re-seeds it instead of re-running
+          a host it already knows is bad. Permanent for the job.
+        * ``"drained"``: graceful departure — the host is excluded from
+          new assignments via the *draining* flag, never written to the
+          blacklist scope, and re-admitted when its drain completes and
+          discovery reports it again.
+        """
+        if reason == "drained":
+            self._host_manager.mark_draining(host)
+            return
         self._host_manager.blacklist(host)
         try:
-            self._rendezvous.put(BLACKLIST_SCOPE, host, b"1")
+            self._rendezvous.put(BLACKLIST_SCOPE, host, reason.encode())
         except Exception:
             log.debug("elastic: could not persist blacklist entry for %s",
                       host, exc_info=True)
 
+    def record_preemption_notice(self, host: str, grace: float = 0.0,
+                                 ts: Optional[float] = None,
+                                 persist: bool = True) -> None:
+        """One path in for every preemption producer — the ``preempt``
+        fault kind (worker PUT), the HTTP ``preempt`` scope, and
+        ``HostDiscovery.find_preempted_hosts`` polling all land here.
+
+        Under the default ``drain`` scale-down policy the host is marked
+        draining (excluded from the next generation, never blacklisted,
+        heartbeat tracking dropped before its beats stop); the discovery
+        loop then owes the coordinator a membership notice and the normal
+        re-rendezvous retires the host's workers cleanly. ``immediate``
+        policy falls back to the legacy kill path (host event -> nonzero
+        exit -> FAILURE -> blacklist). Idempotent per in-flight drain.
+
+        ``persist=False`` is used by the rendezvous PUT handler (the
+        notice is already in the journaled store) and by journal restore.
+        """
+        if self.finished() or self._host_manager.is_blacklisted(host):
+            return
+        if self._scale_down_policy == "immediate":
+            if host in self._host_assignments:
+                log.warning("elastic: preemption notice for %s; scale-down "
+                            "policy 'immediate' kills it now", host)
+                _M_PREEMPTIONS.labels(outcome="immediate").inc()
+                _M_SCALE_EVENTS.labels(direction="down").inc()
+                self._host_manager.fire_host_event(host)
+            return
+        with self._wait_hosts_cond:
+            if self._host_manager.is_draining(host):
+                return  # drain already in flight
+            log.warning(
+                "elastic: preemption notice for %s (grace=%.1fs); draining "
+                "gracefully — excluded from new assignments, not "
+                "blacklisted, re-admittable when capacity returns",
+                host, grace)
+            self._host_manager.mark_draining(host)
+            self._draining[host] = {
+                "grace": float(grace),
+                "ts": float(ts) if ts is not None else time.time(),
+                "start": time.monotonic()}
+            # Forget the host's heartbeat slots BEFORE their beats stop:
+            # the armed-then-silent detector must not declare a clean
+            # departure dead (record_heartbeat also drops new beats while
+            # the drain is in flight, so the slot cannot re-arm).
+            for slot_info in self._host_assignments.get(host, []):
+                self._heartbeat_monitor.forget(host, slot_info.local_rank)
+            _M_SCALE_EVENTS.labels(direction="down").inc()
+            self._wait_hosts_cond.notify_all()
+        if persist:
+            try:
+                self._rendezvous.put(PREEMPT_SCOPE, host,
+                                     encode_notice(grace, ts))
+            except Exception:
+                log.debug("elastic: could not persist preemption notice "
+                          "for %s", host, exc_info=True)
+
+    def is_draining(self, host: str) -> bool:
+        return self._host_manager.is_draining(host)
+
+    def _complete_drain(self, host: str) -> None:
+        """The drained host has left the generation: observe the drain
+        latency, count the outcome, clear the draining flag (re-admission
+        on the next discovery poll) and retire the journaled notice.
+        Idempotent (inline reform detection and the poll sweep can race)."""
+        if not self._host_manager.is_draining(host):
+            return
+        info = self._draining.pop(host, None)
+        if info is not None:
+            _M_DRAIN_SECONDS.observe(time.monotonic() - info["start"])
+        _M_PREEMPTIONS.labels(outcome="drained").inc()
+        self._host_manager.clear_draining(host)
+        log.warning("elastic: drain of %s complete; host is re-admittable "
+                    "when discovery reports it again", host)
+        try:
+            self._rendezvous.delete(PREEMPT_SCOPE, host)
+        except Exception:
+            log.debug("elastic: could not retire preemption notice for %s",
+                      host, exc_info=True)
+
     def restore_from_rendezvous(self) -> int:
         """Re-seed driver state from a journal-restored KV store: worker
-        notification addresses and the blacklist. Called by the launcher
-        after ``attach_elastic_handlers`` when the rendezvous came back
-        from disk (coordinator hot-restart path); a fresh store holds
-        nothing and this is a no-op. Returns the number of re-seeded
-        entries."""
+        notification addresses, the blacklist, and in-flight preemption
+        drains. Called by the launcher after ``attach_elastic_handlers``
+        when the rendezvous came back from disk (coordinator hot-restart
+        path); a fresh store holds nothing and this is a no-op. Returns
+        the number of re-seeded entries."""
         import pickle
 
         count = 0
         for host in self._rendezvous.items(BLACKLIST_SCOPE):
             if not self._host_manager.is_blacklisted(host):
                 self._host_manager.blacklist(host)
+                count += 1
+        # Drains survive a coordinator restart: the preempt scope is
+        # journaled, so a notice recorded before the crash keeps its host
+        # out of the restarted coordinator's first generation too.
+        for host, blob in self._rendezvous.items(PREEMPT_SCOPE).items():
+            if not self._host_manager.is_draining(host):
+                grace, ts = decode_notice(blob)
+                self.record_preemption_notice(host, grace, ts=ts,
+                                              persist=False)
                 count += 1
         for key, blob in self._rendezvous.items(PUT_WORKER_ADDRESSES).items():
             host, _, local_rank = key.rpartition(":")
@@ -328,6 +467,18 @@ class ElasticDriver:
                     log.warning("elastic: discovery failed; retrying",
                                 exc_info=True)
             first = False
+            # Scheduler-announced reclaims ride the same notice path as
+            # the preempt scope and fault kind: poll the discovery
+            # object's preemption view each cycle.
+            try:
+                preempted = self._discovery.find_preempted_hosts()
+            except Exception:
+                preempted = {}
+                log.warning("elastic: preemption discovery failed; "
+                            "retrying", exc_info=True)
+            for host, grace in (preempted or {}).items():
+                self.record_preemption_notice(host, grace)
+            self._sweep_completed_drains()
             # Every poll: (re)derive whether a host-change notice is owed
             # and deliver it. Deriving from current state each cycle (not
             # only on a discovery delta) makes the notice self-healing —
@@ -338,6 +489,24 @@ class ElasticDriver:
             self._deliver_pending_notice()
             self._shutdown.wait(DISCOVER_HOSTS_FREQUENCY_SECS)
 
+    def _sweep_completed_drains(self) -> None:
+        """A drain is complete once the draining host no longer holds any
+        assignment — the re-rendezvous formed a generation without it (the
+        common case, also detected inline by ``_update_host_assignments``)
+        or it never held one (a spare being reclaimed)."""
+        with self._wait_hosts_cond:
+            if not self._host_assignments:
+                # No generation yet (startup / journal restore): a drain
+                # can't be "complete" before the first generation forms
+                # without the host.
+                return
+            done = [h for h in self._host_manager.draining_hosts()
+                    if h not in self._host_assignments]
+            for host in done:
+                self._complete_drain(host)
+            if done:
+                self._wait_hosts_cond.notify_all()
+
     def _refresh_pending_notice(self) -> None:
         with self._wait_hosts_cond:
             current = self._host_manager.current_hosts
@@ -347,8 +516,32 @@ class ElasticDriver:
             if next_assignments == self.host_assignments:
                 # Current generation already reflects the membership.
                 self._pending_notice_ts = None
+                self._scaleup_since = None
             elif self._pending_notice_ts is None and self._host_assignments:
+                if self._is_grow_only(next_assignments):
+                    # Pure growth is deliberate, not reactive: wait out
+                    # HVD_TPU_ELASTIC_SCALE_UP_DELAY before interrupting
+                    # the running generation, so one flapping discovery
+                    # poll can't trigger a resize. Any shrink (host lost
+                    # or draining) still interrupts immediately.
+                    now = time.monotonic()
+                    if self._scaleup_since is None:
+                        self._scaleup_since = now
+                    if now - self._scaleup_since < self._scale_up_delay:
+                        return
+                self._scaleup_since = None
                 self._pending_notice_ts = time.time()
+
+    def _is_grow_only(self, next_assignments: Dict[str, List[SlotInfo]]
+                      ) -> bool:
+        """True when the pending membership delta only ADDS slots — every
+        currently assigned (host, slot) survives into the next layout."""
+        prev = {(host, s.local_rank)
+                for host, slots in self._host_assignments.items()
+                for s in slots}
+        new = {(host, s.local_rank)
+               for host, slots in next_assignments.items() for s in slots}
+        return bool(new - prev) and not (prev - new)
 
     def _deliver_pending_notice(self) -> None:
         ts = self._pending_notice_ts
@@ -399,9 +592,17 @@ class ElasticDriver:
                    for host, slots in by_host.items() for s in slots}
             if new - prev:
                 _M_RANK_ADDED.inc(len(new - prev))
+                _M_SCALE_EVENTS.labels(direction="up").inc()
             if prev - new:
                 _M_RANK_REMOVED.inc(len(prev - new))
         self._host_assignments = by_host
+        # Drains complete the moment a generation forms without the host
+        # (precise hvd_tpu_elastic_drain_seconds; the 1 Hz sweep is the
+        # backstop for hosts that never held an assignment).
+        with self._wait_hosts_cond:
+            for host in self._host_manager.draining_hosts():
+                if host not in by_host:
+                    self._complete_drain(host)
         self._world_size = len(assignment_list)
         # The generation being formed already reflects current membership;
         # a pending host-change notice would only re-interrupt it.

@@ -11,6 +11,7 @@ import pickle
 
 from ..runner.rendezvous import RendezvousServer
 from .heartbeat import HEARTBEAT_SCOPE
+from .preemption import PREEMPT_SCOPE, decode_notice
 from .worker import PUT_WORKER_ADDRESSES
 
 GET_RANK_AND_SIZE = "rank_and_size"
@@ -44,3 +45,17 @@ def attach_elastic_handlers(rendezvous: RendezvousServer, driver) -> None:
         rendezvous.add_put_handler(HEARTBEAT_SCOPE, record_heartbeat)
     # liveness is only meaningful live: never journal or snapshot beats
     rendezvous.ephemeral_scopes.add(HEARTBEAT_SCOPE)
+
+    record_notice = getattr(driver, "record_preemption_notice", None)
+    if record_notice is not None:
+
+        def put_preemption_notice(key: str, value: bytes):
+            # One channel for every producer: the worker-side fault kind,
+            # an operator's HTTP PUT (curl .../preempt/<host>), and
+            # journal replay all route here. persist=False — this PUT is
+            # already in the (journaled, NOT ephemeral) store; a drain
+            # must survive a coordinator restart.
+            grace, ts = decode_notice(value)
+            record_notice(key, grace, ts=ts, persist=False)
+
+        rendezvous.add_put_handler(PREEMPT_SCOPE, put_preemption_notice)

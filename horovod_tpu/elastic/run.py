@@ -220,13 +220,25 @@ def reset(state=None) -> None:
     _M_RESTARTS.inc()
     # Async checkpoint saves must land (or fail visibly) before this
     # process image goes away: a re-exec with a snapshot still queued
-    # would silently drop the newest checkpoint.
+    # would silently drop the newest checkpoint. On a preemption drain
+    # this IS the departing host's final flush — the in-flight sharded
+    # save completes before the process exits, so the survivors' restore
+    # sees the full pre-notice progress.
     from ..checkpointing import drain_all
     drain_all()
     basics.shutdown()
     if not requery_assignment():
+        # No slot in the new generation: this host was removed — either
+        # reclaimed after a preemption drain or simply scaled away. Leave
+        # the last committed snapshot durably on disk (the survivors'
+        # broadcast path and a later re-admitted worker both read it) and
+        # retire the notification plane so the driver never sees this exit
+        # as anything but clean.
+        if state is not None:
+            persist_committed_state(state)
+        notification_manager.shutdown()
         log.info("elastic: this worker has no assignment in the new "
-                 "generation; exiting cleanly")
+                 "generation; drain complete, exiting cleanly")
         sys.exit(0)
     if os.environ.get("HVD_TPU_ELASTIC") == "1":
         # XLA backends cannot re-rendezvous in-process: restart the worker

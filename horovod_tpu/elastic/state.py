@@ -18,8 +18,17 @@ from ..exceptions import HostsUpdatedInterrupt
 # ``worker.step:crash:step=N`` hard-kills this worker at its N-th commit
 # — the deterministic stand-in for `kill -9` in recovery drills. Fired
 # BEFORE save(), so a crash here loses exactly the uncommitted step (the
-# same contract as a real mid-step kill).
+# same contract as a real mid-step kill). A ``preempt`` rule here instead
+# *announces* this worker's host to the driver's graceful-drain path (the
+# deterministic stand-in for a fleet reclaim notice) and lets the commit
+# proceed — so the notice always post-dates a fresh commit, exactly like
+# a real scheduler warning landing between steps.
 _FP_STEP = _faults.FaultPoint("worker.step")
+
+
+def _announce_preemption(grace: float) -> None:
+    from .worker import notification_manager
+    notification_manager.send_preemption_notice(grace)
 
 
 def _default_bcast_object(obj, root_rank=0, name=None):
@@ -66,7 +75,7 @@ class State:
         self._host_messages.put(timestamp)
 
     def commit(self) -> None:
-        _FP_STEP.fire()
+        _FP_STEP.fire(preempt=_announce_preemption)
         self.save()
         # Durability on EVERY commit, not just the graceful re-exec path:
         # a worker hard-killed by the runtime (peer-death cascade through

@@ -71,6 +71,8 @@ class WorkerNotificationManager:
         self._service: Optional[WorkerNotificationService] = None
         self._listeners = set()
         self._heartbeat: Optional[HeartbeatSender] = None
+        self._client = None
+        self._hostname: Optional[str] = None
 
     def init(self, rendezvous_addr: Optional[str] = None,
              rendezvous_port: Optional[int] = None,
@@ -144,6 +146,33 @@ class WorkerNotificationManager:
             self._heartbeat = HeartbeatSender(client, hostname, local_rank,
                                               rank)
             self._heartbeat.start()
+            # Kept for the preemption-notice PUT (send_preemption_notice):
+            # notices ride the same KV channel as registration/beats.
+            self._client = client
+            self._hostname = hostname
+
+    def send_preemption_notice(self, grace: float = 0.0) -> bool:
+        """PUT a preemption notice for THIS worker's host to the journaled
+        ``preempt`` scope — the drill path of the shared notice channel
+        (the ``preempt`` fault kind lands here via the elastic State's
+        commit fault point). Returns True when the notice reached the
+        store; False on a non-elastic launch or a delivery failure (the
+        driver's discovery poll is the production backstop, so best-effort
+        is correct here)."""
+        with self._lock:
+            client, hostname = self._client, self._hostname
+        if client is None or not hostname:
+            return False
+        from .preemption import PREEMPT_SCOPE, encode_notice
+        try:
+            client.put(PREEMPT_SCOPE, hostname, encode_notice(grace))
+            log.warning("elastic: preemption notice sent for %s "
+                        "(grace=%.1fs)", hostname, grace)
+            return True
+        except Exception:
+            log.warning("elastic: preemption notice for %s not delivered",
+                        hostname, exc_info=True)
+            return False
 
     def register_listener(self, listener) -> None:
         self._listeners.add(listener)
@@ -163,6 +192,8 @@ class WorkerNotificationManager:
             if self._service:
                 self._service.shutdown()
                 self._service = None
+            self._client = None
+            self._hostname = None
 
 
 notification_manager = WorkerNotificationManager()

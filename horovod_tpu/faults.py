@@ -74,7 +74,7 @@ _M_INJECTED = _metrics.counter(
 #: a chaos harness can tell an injected kill from an organic failure.
 CRASH_EXIT_CODE = 29
 
-_KINDS = ("error", "neterror", "delay", "hang", "crash")
+_KINDS = ("error", "neterror", "delay", "hang", "crash", "preempt")
 
 
 class InjectedFault(RuntimeError):
@@ -97,11 +97,11 @@ class _Rule:
     """One parsed spec entry (site prefix + kind + scoping params)."""
 
     __slots__ = ("site", "kind", "seconds", "rate", "after", "step",
-                 "times", "rank", "text", "index")
+                 "times", "rank", "grace", "text", "index")
 
     def __init__(self, site: str, kind: str, seconds: float, rate: float,
                  after: int, step: Optional[int], times: Optional[int],
-                 rank: Optional[int], text: str, index: int):
+                 rank: Optional[int], grace: float, text: str, index: int):
         self.site = site
         self.kind = kind
         self.seconds = seconds
@@ -110,6 +110,7 @@ class _Rule:
         self.step = step
         self.times = times
         self.rank = rank
+        self.grace = grace
         self.text = text
         self.index = index
 
@@ -160,13 +161,14 @@ def _parse_entry(entry: str, index: int) -> _Rule:
     seconds = 0.0
     rate = 1.0
     after = 0
+    grace = 0.0
     step = times = rank = None
     for field in fields[1:]:
         key, eq, value = field.partition("=")
         if not eq:
             if key == "once":
                 times = 1
-            elif key in ("error", "neterror", "crash"):
+            elif key in ("error", "neterror", "crash", "preempt"):
                 kind = key
             elif key == "hang":
                 kind, seconds = "hang", 1e9
@@ -187,6 +189,8 @@ def _parse_entry(entry: str, index: int) -> _Rule:
                 times = int(value)
             elif key == "rank":
                 rank = int(value)
+            elif key == "grace":
+                grace = float(value)
             else:
                 raise FaultSpecError(
                     f"fault spec entry {entry!r}: unknown param {key!r}")
@@ -199,7 +203,7 @@ def _parse_entry(entry: str, index: int) -> _Rule:
         raise FaultSpecError(
             f"fault spec entry {entry!r}: no kind among {_KINDS}")
     return _Rule(site, kind, seconds, rate, after, step, times, rank,
-                 entry, index)
+                 grace, entry, index)
 
 
 def parse_spec(spec: str) -> List[_Rule]:
@@ -308,7 +312,8 @@ class FaultPoint:
                     self._gen = reg.gen
         return self._bound
 
-    def fire(self, crash: Optional[Callable[[], None]] = None) -> None:
+    def fire(self, crash: Optional[Callable[[], None]] = None,
+             preempt: Optional[Callable[[float], None]] = None) -> None:
         """Inject any matching faults; raises / sleeps / exits per kind.
 
         ``crash``: optional site-owned substitute for ``os._exit`` on
@@ -317,10 +322,18 @@ class FaultPoint:
         server) must simulate its component dying without taking the
         whole job control plane down with it — the owner passes the
         simulation (e.g. ``KVStoreServer._simulate_crash``) here.
+
+        ``preempt``: site-owned delivery of a preemption *notice* on
+        ``preempt`` faults — called with the rule's ``grace`` seconds.
+        Unlike every other kind this one doesn't fail anything: it
+        simulates the fleet scheduler announcing a reclaim, and the
+        owner forwards it into the graceful-drain path. A site without
+        a handler ignores the rule (notice kinds only mean something
+        where a notice channel exists).
         """
         if _ACTIVE is None and _configured:
             return  # hot path: injection off
-        err = self._evaluate(crash=crash)
+        err = self._evaluate(crash=crash, preempt=preempt)
         if err is not None:
             raise err
 
@@ -332,7 +345,8 @@ class FaultPoint:
             return False
         return self._evaluate() is not None
 
-    def _evaluate(self, crash: Optional[Callable[[], None]] = None
+    def _evaluate(self, crash: Optional[Callable[[], None]] = None,
+                  preempt: Optional[Callable[[float], None]] = None
                   ) -> Optional[BaseException]:
         if not _configured:
             configure()
@@ -351,6 +365,13 @@ class FaultPoint:
                         self.site, rule.kind, rule.text, bound.hits)
             if rule.kind in ("delay", "hang"):
                 time.sleep(rule.seconds)
+            elif rule.kind == "preempt":
+                if preempt is not None:
+                    preempt(rule.grace)
+                else:
+                    log.warning(
+                        "preempt fault matched site %s but the site has "
+                        "no notice handler; ignoring", self.site)
             elif rule.kind == "crash":
                 if crash is not None:
                     crash()

@@ -119,6 +119,23 @@ def test_chaos_coordinator_suite_is_seeded_and_exclusive():
         os.path.join(root, "tests", "test_coordinator_recovery.py"))
 
 
+def test_chaos_preempt_suite_is_seeded_and_exclusive():
+    """The preemption drills (preempt fault kind, graceful drain,
+    scale-policy knobs, drain-vs-checkpoint races, 2-proc e2e drill)
+    run as their own seeded CI suite; the generic unit and chaos suites
+    must not run the same file twice."""
+    by_name = {name: cmd for name, cmd, _t in COMMON_SUITES}
+    assert "chaos-preempt" in by_name
+    cmd = by_name["chaos-preempt"]
+    assert "HVD_TPU_FAULT_SEED=" in cmd
+    assert "tests/test_preemption.py" in cmd
+    assert "--ignore=tests/test_preemption.py" in by_name["unit"]
+    assert "--ignore=tests/test_preemption.py" in by_name["chaos"]
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    assert os.path.exists(
+        os.path.join(root, "tests", "test_preemption.py"))
+
+
 def test_checkpoint_suite_is_seeded_and_exclusive():
     """The checkpointing drills (writer crash, corruption walk-back, GC)
     run as their own seeded CI suite; the generic unit and chaos suites

@@ -1,0 +1,646 @@
+"""Preemption-grade elasticity tests (CI suite ``chaos-preempt``).
+
+Covers the ``preempt`` fault kind (grammar + FaultPoint dispatch), the
+notice codec and its one-channel routing (worker PUT / rendezvous handler
+/ discovery poll / journal restore), the driver's graceful-drain path
+(never blacklisted, heartbeat forgotten, re-admittable, metrics), the
+scale-up debounce / scale-down policy knobs, the drain-vs-checkpoint
+races, and — integration-marked — the seeded 2-process preemption drill
+through the real launcher (the deterministic stand-in for a fleet
+scheduler reclaiming a TPU host mid-training).
+"""
+
+import os
+import re
+import tempfile
+import threading
+import time
+
+import pytest
+
+from horovod_tpu import faults as F
+from horovod_tpu import metrics as M
+from horovod_tpu.elastic.discovery import FixedHosts, HostManager
+from horovod_tpu.elastic.driver import ElasticDriver
+from horovod_tpu.elastic.preemption import (PREEMPT_SCOPE, decode_notice,
+                                            encode_notice)
+from horovod_tpu.elastic.state import ObjectState
+from horovod_tpu.elastic.worker import WorkerNotificationManager
+
+SEED = 1234
+
+
+@pytest.fixture(autouse=True)
+def _reset_faults():
+    """Every test leaves the process-wide fault registry disabled."""
+    yield
+    F.configure("", seed=0)
+
+
+def _counter(name):
+    return float(M.snapshot().get(name, 0.0))
+
+
+def _wait_until(pred, timeout=10.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(0.02)
+    return False
+
+
+def _identity_bcast(obj, root_rank=0, name=None):
+    return obj
+
+
+class RecordingRendezvous:
+    """Driver-facing KV double: records publishes, PUTs and deletes, and
+    serves ``items()`` for the journal-restore path."""
+
+    def __init__(self, data=None):
+        self.published = []
+        self.stopped = False
+        self.data = {scope: dict(kv) for scope, kv in (data or {}).items()}
+        self.puts = []
+        self.deletes = []
+
+    def init(self, assignment_list):
+        self.published.append(list(assignment_list))
+
+    def stop(self):
+        self.stopped = True
+
+    def put(self, scope, key, value):
+        self.data.setdefault(scope, {})[key] = value
+        self.puts.append((scope, key, value))
+
+    def delete(self, scope, key):
+        self.data.get(scope, {}).pop(key, None)
+        self.deletes.append((scope, key))
+
+    def items(self, scope):
+        return dict(self.data.get(scope, {}))
+
+
+# ---------------------------------------------------------------------------
+# fault grammar: the preempt kind
+# ---------------------------------------------------------------------------
+
+class TestPreemptGrammar:
+    def test_parse_preempt_with_grace(self):
+        rule = F.parse_spec("worker.step:preempt:step=3:rank=1:grace=5")[0]
+        assert rule.kind == "preempt"
+        assert rule.step == 3
+        assert rule.rank == 1
+        assert rule.grace == 5.0
+
+    def test_bare_preempt_defaults(self):
+        rule = F.parse_spec("x:preempt")[0]
+        assert rule.kind == "preempt"
+        assert rule.grace == 0.0
+        assert rule.step is None and rule.rank is None
+
+    def test_bad_grace_value_fails_fast(self):
+        with pytest.raises(F.FaultSpecError, match="grace"):
+            F.parse_spec("x:preempt:grace=soon")
+
+    def test_preempt_fires_handler_at_step_with_grace(self):
+        F.configure("w.s:preempt:step=2:grace=7.5", seed=SEED)
+        fp = F.FaultPoint("w.s")
+        base = _counter('hvd_tpu_faults_injected_total'
+                        '{site="w.s",kind="preempt"}')
+        notices = []
+        fp.fire(preempt=notices.append)
+        assert notices == []                      # hit 1: not yet
+        fp.fire(preempt=notices.append)
+        assert notices == [7.5]                   # hit 2: the notice
+        fp.fire(preempt=notices.append)
+        assert notices == [7.5]                   # step= fires exactly once
+        assert _counter('hvd_tpu_faults_injected_total'
+                        '{site="w.s",kind="preempt"}') == base + 1
+
+    def test_preempt_without_handler_is_ignored(self):
+        """A site with no notice channel must not fail when a preempt rule
+        matches — the rule is logged and skipped, nothing raises."""
+        F.configure("no.handler:preempt:step=1", seed=SEED)
+        F.FaultPoint("no.handler").fire()         # no preempt= callback
+
+    def test_preempt_respects_rank_filter(self, monkeypatch):
+        F.configure("r.s:preempt:rank=1:grace=2", seed=SEED)
+        monkeypatch.setenv("HVD_TPU_RANK", "0")
+        got = []
+        F.FaultPoint("r.s").fire(preempt=got.append)
+        assert got == []
+        monkeypatch.setenv("HVD_TPU_RANK", "1")
+        F.configure("r.s:preempt:rank=1:grace=2", seed=SEED)
+        F.FaultPoint("r.s").fire(preempt=got.append)
+        assert got == [2.0]
+
+    def test_state_commit_routes_notice_to_manager(self, monkeypatch):
+        """State.commit() is the worker-side producer: a matched preempt
+        rule announces THIS host through the notification manager's KV
+        client, and the commit itself still completes."""
+        from horovod_tpu.elastic.worker import notification_manager
+
+        sent = []
+
+        class FakeClient:
+            def put(self, scope, key, value):
+                sent.append((scope, key, value))
+
+        monkeypatch.setattr(notification_manager, "_client", FakeClient())
+        monkeypatch.setattr(notification_manager, "_hostname", "host-a")
+        F.configure("worker.step:preempt:step=1:grace=2.5", seed=SEED)
+        state = ObjectState(bcast_object=_identity_bcast,
+                            get_rank=lambda: 0, epoch=4)
+        state.commit()                            # must not raise
+        assert state._saved_state["epoch"] == 4   # the commit landed
+        assert len(sent) == 1
+        scope, key, value = sent[0]
+        assert scope == PREEMPT_SCOPE and key == "host-a"
+        grace, _ts = decode_notice(value)
+        assert grace == 2.5
+
+
+# ---------------------------------------------------------------------------
+# notice codec
+# ---------------------------------------------------------------------------
+
+class TestNoticeCodec:
+    def test_roundtrip(self):
+        grace, ts = decode_notice(encode_notice(12.5, ts=1000.0))
+        assert grace == 12.5 and ts == 1000.0
+
+    def test_tolerant_decode(self):
+        for blob in (b"5.5", b"", b"not json", b'{"nope": 1}', None):
+            grace, ts = decode_notice(blob)
+            assert grace >= 0.0 and ts > 0.0
+        assert decode_notice(b"5.5")[0] == 5.5    # bare number: grace
+
+
+# ---------------------------------------------------------------------------
+# worker-side notice sender
+# ---------------------------------------------------------------------------
+
+class TestWorkerNotice:
+    def test_send_without_client_is_false(self):
+        m = WorkerNotificationManager()
+        assert m.send_preemption_notice(3.0) is False
+
+    def test_send_with_client_puts_to_preempt_scope(self):
+        m = WorkerNotificationManager()
+        sent = []
+
+        class FakeClient:
+            def put(self, scope, key, value):
+                sent.append((scope, key, value))
+
+        m._client = FakeClient()
+        m._hostname = "host-b"
+        assert m.send_preemption_notice(9.0) is True
+        assert sent[0][0] == PREEMPT_SCOPE and sent[0][1] == "host-b"
+        assert decode_notice(sent[0][2])[0] == 9.0
+
+    def test_send_failure_is_best_effort(self):
+        m = WorkerNotificationManager()
+
+        class BrokenClient:
+            def put(self, scope, key, value):
+                raise ConnectionError("down")
+
+        m._client = BrokenClient()
+        m._hostname = "host-c"
+        assert m.send_preemption_notice(1.0) is False
+
+
+# ---------------------------------------------------------------------------
+# discovery: draining exclusion + re-admission
+# ---------------------------------------------------------------------------
+
+def test_host_manager_draining_excluded_then_readmitted():
+    """Regression: draining must filter a FRESH snapshot, not mutate the
+    stored one — after clear_draining the host reappears in the order
+    without any discovery change."""
+    hm = HostManager(FixedHosts({"a": 1, "b": 1}))
+    assert hm.update_available_hosts()
+    assert hm.current_hosts.host_assignment_order == ["a", "b"]
+    hm.mark_draining("b")
+    assert hm.is_draining("b")
+    assert hm.current_hosts.host_assignment_order == ["a"]
+    assert hm.current_hosts.count_available_slots() == 1
+    # no discovery poll in between: same data, flag cleared -> re-admitted
+    hm.clear_draining("b")
+    assert hm.current_hosts.host_assignment_order == ["a", "b"]
+    assert not hm.is_blacklisted("b")
+
+
+# ---------------------------------------------------------------------------
+# driver simulation: graceful drain
+# ---------------------------------------------------------------------------
+
+class TestGracefulDrain:
+    def test_drain_retires_host_without_blacklist(self):
+        """The acceptance drill, process-free: notice for h2 -> next
+        generation forms without it, h2's clean exit records nothing,
+        preemptions_total{outcome=drained} ticks, the journaled notice is
+        retired, and h2 is re-admittable — never blacklisted."""
+        rdv = RecordingRendezvous()
+        driver = ElasticDriver(rdv, FixedHosts({"h1": 1, "h2": 1}),
+                               min_np=1, max_np=2, timeout=10)
+        notice = threading.Event()
+
+        def create_worker(slot_info, events):
+            # both workers run until the notice, then re-rendezvous (the
+            # re-exec path in process terms); h2 gets no slot in gen 2 and
+            # its clean exit must be ignored by the driver
+            notice.wait(10)
+            driver.record_ready(slot_info.hostname, slot_info.local_rank)
+            return 0, time.time()
+
+        driver.start(2, create_worker)
+        assert driver.world_size() == 2
+        drained0 = _counter(
+            'hvd_tpu_elastic_preemptions_total{outcome="drained"}')
+        down0 = _counter(
+            'hvd_tpu_elastic_scale_events_total{direction="down"}')
+
+        driver.record_preemption_notice("h2", grace=5.0)
+        assert driver.is_draining("h2")
+        # idempotent per in-flight drain
+        driver.record_preemption_notice("h2", grace=5.0)
+        assert _counter('hvd_tpu_elastic_scale_events_total'
+                        '{direction="down"}') == down0 + 1
+        # the notice is journaled (survives a coordinator restart)
+        assert "h2" in rdv.data.get(PREEMPT_SCOPE, {})
+
+        notice.set()
+        results = driver.get_results()
+        assert results.error_message is None
+        assert driver.world_size() == 1
+        code, _ = results.worker_results["h1[0]"]
+        assert code == 0
+
+        # drained, never blacklisted, re-admittable
+        assert not driver._host_manager.is_blacklisted("h2")
+        assert not driver.is_draining("h2")
+        assert "h2" in driver._host_manager.current_hosts.available_hosts
+        assert _counter('hvd_tpu_elastic_preemptions_total'
+                        '{outcome="drained"}') == drained0 + 1
+        # journaled notice retired on completion; blacklist scope untouched
+        assert "h2" not in rdv.data.get(PREEMPT_SCOPE, {})
+        assert all(scope != "blacklist" for scope, _k, _v in rdv.puts)
+        driver.stop()
+
+    def test_drain_forgets_heartbeat_and_gates_stragglers(self):
+        """Satellite regression: a draining host's slots are forgotten at
+        notice time and straggler beats through the grace window cannot
+        re-arm them — its expected silence never ticks the miss counter."""
+        rdv = RecordingRendezvous()
+        driver = ElasticDriver(rdv, FixedHosts({"h1": 1, "h2": 1}),
+                               min_np=1, max_np=2, timeout=10)
+        notice = threading.Event()
+
+        def create_worker(slot_info, events):
+            notice.wait(10)
+            driver.record_ready(slot_info.hostname, slot_info.local_rank)
+            return 0, time.time()
+
+        driver.start(2, create_worker)
+        monitor = driver._heartbeat_monitor
+        driver.record_heartbeat("h2:0", b"1")
+        assert monitor.last_beat_age("h2", 0) is not None
+
+        driver.record_preemption_notice("h2", grace=1.0)
+        assert monitor.last_beat_age("h2", 0) is None   # forgotten
+        driver.record_heartbeat("h2:0", b"1")           # straggler beat
+        assert monitor.last_beat_age("h2", 0) is None   # gated, not re-armed
+
+        # even with an absurdly short timeout the forgotten slot cannot be
+        # declared dead: nothing is armed for it anymore
+        misses0 = _counter('hvd_tpu_heartbeat_misses_total{rank="1"}')
+        monitor._timeout = 0.05
+        time.sleep(0.15)
+        monitor.check_now()
+        assert _counter(
+            'hvd_tpu_heartbeat_misses_total{rank="1"}') == misses0
+
+        notice.set()
+        results = driver.get_results()
+        assert results.error_message is None
+        assert not driver._host_manager.is_blacklisted("h2")
+        driver.stop()
+
+    def test_blacklist_reason_semantics(self):
+        """reason='drained' excludes without blacklisting (and never
+        touches the journaled blacklist scope); the default reason stays
+        the persisted hard blacklist."""
+        rdv = RecordingRendezvous()
+        driver = ElasticDriver(rdv, FixedHosts({"h1": 1}), min_np=1,
+                               timeout=5)
+        driver.blacklist_host("hx", reason="drained")
+        assert driver.is_draining("hx")
+        assert not driver._host_manager.is_blacklisted("hx")
+        driver.blacklist_host("hy")
+        assert driver._host_manager.is_blacklisted("hy")
+        assert rdv.data["blacklist"] == {"hy": b"failure"}
+        assert "hx" not in rdv.data["blacklist"]
+        driver.stop()
+
+    def test_scale_down_policy_immediate_uses_kill_path(self, monkeypatch):
+        """HVD_TPU_ELASTIC_SCALE_DOWN_POLICY=immediate: the notice fires
+        the legacy host event -> worker exit -> FAILURE -> blacklist."""
+        monkeypatch.setenv("HVD_TPU_ELASTIC_SCALE_DOWN_POLICY", "immediate")
+        rdv = RecordingRendezvous()
+        driver = ElasticDriver(rdv, FixedHosts({"h1": 1, "h2": 1}),
+                               min_np=1, max_np=2, timeout=10)
+
+        def create_worker(slot_info, events):
+            if slot_info.hostname == "h2":
+                # events[1] is the host event: the notice kills this worker
+                fired = events[1].wait(10)
+                return (1 if fired else 0), time.time()
+            driver.record_ready("h1", 0)
+            return 0, time.time()
+
+        imm0 = _counter(
+            'hvd_tpu_elastic_preemptions_total{outcome="immediate"}')
+        driver.start(2, create_worker)
+        driver.record_preemption_notice("h2", grace=30.0)
+        results = driver.get_results()
+        assert results.error_message is None
+        assert driver.world_size() == 1
+        assert driver._host_manager.is_blacklisted("h2")
+        assert not driver.is_draining("h2")
+        assert _counter('hvd_tpu_elastic_preemptions_total'
+                        '{outcome="immediate"}') == imm0 + 1
+        driver.stop()
+
+    def test_scale_up_debounce_defers_growth(self, monkeypatch):
+        """HVD_TPU_ELASTIC_SCALE_UP_DELAY holds a grow-only delta: no
+        membership notice is owed while the debounce runs, and growth
+        proceeds normally once the delay is satisfied."""
+        monkeypatch.setenv("HVD_TPU_ELASTIC_SCALE_UP_DELAY", "3600")
+        rdv = RecordingRendezvous()
+        fixed = FixedHosts({"h1": 1})
+        driver = ElasticDriver(rdv, fixed, min_np=1, max_np=2, timeout=15)
+        go = threading.Event()
+
+        def create_worker(slot_info, events):
+            if slot_info.hostname == "h1" and not getattr(
+                    create_worker, "h1_restarted", False):
+                create_worker.h1_restarted = True
+                go.wait(15)
+                driver.record_ready("h1", 0)     # re-rendezvous into gen 2
+                return 0, time.time()
+            return 0, time.time()
+
+        driver.start(1, create_worker)
+        assert driver.world_size() == 1
+        fixed.set({"h1": 1, "h2": 1})
+        assert _wait_until(lambda: driver._host_manager.current_hosts
+                           .count_available_slots() == 2)
+        # the grow-only delta is seen but held by the debounce
+        assert _wait_until(lambda: driver._scaleup_since is not None)
+        time.sleep(2.2)
+        assert driver._pending_notice_ts is None
+        assert driver.world_size() == 1
+        # delay satisfied (simulated): the very next poll owes the notice
+        driver._scale_up_delay = 0.0
+        assert _wait_until(lambda: driver._pending_notice_ts is not None)
+        go.set()
+        results = driver.get_results()
+        assert results.error_message is None
+        assert driver.world_size() == 2
+        driver.stop()
+
+    def test_shrink_bypasses_scale_up_debounce(self, monkeypatch):
+        """A drain (shrink) must interrupt immediately even under a huge
+        scale-up delay — the debounce only applies to pure growth."""
+        monkeypatch.setenv("HVD_TPU_ELASTIC_SCALE_UP_DELAY", "3600")
+        rdv = RecordingRendezvous()
+        driver = ElasticDriver(rdv, FixedHosts({"h1": 1, "h2": 1}),
+                               min_np=1, max_np=2, timeout=10)
+        notice = threading.Event()
+
+        def create_worker(slot_info, events):
+            notice.wait(10)
+            driver.record_ready(slot_info.hostname, slot_info.local_rank)
+            return 0, time.time()
+
+        driver.start(2, create_worker)
+        driver.record_preemption_notice("h2", grace=0.0)
+        # the shrink notice is owed within a couple of 1 Hz polls
+        assert _wait_until(
+            lambda: driver._pending_notice_ts is not None, timeout=5)
+        notice.set()
+        results = driver.get_results()
+        assert results.error_message is None
+        assert driver.world_size() == 1
+        assert not driver._host_manager.is_blacklisted("h2")
+        driver.stop()
+
+    def test_restore_from_rendezvous_reseeds_drain(self):
+        """A journaled notice survives a coordinator restart: restore
+        re-marks the host draining, and the sweep must NOT complete the
+        drain before the first generation even forms."""
+        blob = encode_notice(3.5, ts=123.0)
+        rdv = RecordingRendezvous({PREEMPT_SCOPE: {"h9": blob}})
+        driver = ElasticDriver(rdv, FixedHosts({"h1": 1}), min_np=1,
+                               timeout=5)
+        count = driver.restore_from_rendezvous()
+        assert count >= 1
+        assert driver.is_draining("h9")
+        assert not driver._host_manager.is_blacklisted("h9")
+        # >1 discovery poll: the no-generation guard keeps the drain open
+        time.sleep(1.3)
+        assert driver.is_draining("h9")
+        assert "h9" in rdv.data[PREEMPT_SCOPE]
+        driver.stop()
+
+    def test_rendezvous_put_handler_routes_notice(self):
+        """The ``preempt`` scope PUT handler decodes the notice and hands
+        it to the driver with persist=False (already journaled) — and the
+        scope is NOT ephemeral (drills and drains must survive a
+        coordinator restart)."""
+        from horovod_tpu.elastic.heartbeat import HEARTBEAT_SCOPE
+        from horovod_tpu.elastic.rendezvous import attach_elastic_handlers
+
+        class StubRendezvous:
+            def __init__(self):
+                self.handlers = {}
+                self.put_handlers = {}
+                self.ephemeral_scopes = set()
+
+            def add_handler(self, scope, fn):
+                self.handlers[scope] = fn
+
+            def add_put_handler(self, scope, fn):
+                self.put_handlers[scope] = fn
+
+        class StubDriver:
+            def __init__(self):
+                self.notices = []
+
+            def record_ready(self, host, slot):
+                pass
+
+            def get_slot_info(self, host, slot):
+                raise AssertionError("unused")
+
+            def register_worker_server(self, *a):
+                pass
+
+            def record_preemption_notice(self, host, grace, ts=None,
+                                         persist=True):
+                self.notices.append((host, grace, ts, persist))
+
+        rdv, drv = StubRendezvous(), StubDriver()
+        attach_elastic_handlers(rdv, drv)
+        assert PREEMPT_SCOPE in rdv.put_handlers
+        assert PREEMPT_SCOPE not in rdv.ephemeral_scopes   # journaled!
+        assert HEARTBEAT_SCOPE in rdv.ephemeral_scopes
+        rdv.put_handlers[PREEMPT_SCOPE]("host-z", encode_notice(4.0))
+        assert len(drv.notices) == 1
+        host, grace, _ts, persist = drv.notices[0]
+        assert host == "host-z" and grace == 4.0 and persist is False
+
+
+# ---------------------------------------------------------------------------
+# drain vs checkpoint races
+# ---------------------------------------------------------------------------
+
+class TestDrainCheckpointRaces:
+    def _tree(self, fill):
+        import jax.numpy as jnp
+        return {"w": jnp.full(16, float(fill), jnp.float32)}
+
+    def test_notice_during_inflight_save_drains_no_duplicate(self, tmp_path):
+        """A notice landing while the async writer still holds the newest
+        step must wait it out, not double-commit it."""
+        from horovod_tpu import checkpointing as cp
+        mgr = cp.CheckpointManager(str(tmp_path))
+        mgr.save(1, self._tree(1), async_=False)
+        tree2 = self._tree(2)
+        mgr.save(2, tree2, async_=True)           # in flight at notice time
+        latest = mgr.drain_for_preemption(step=2, tree=tree2)
+        assert latest == 2
+        assert mgr.all_steps() == [2, 1]          # exactly one step-2 commit
+        import numpy as np
+        np.testing.assert_array_equal(
+            np.asarray(mgr.restore(step=2)["w"]), 2.0)
+
+    def test_drain_forces_final_sync_save_when_stale(self, tmp_path):
+        from horovod_tpu import checkpointing as cp
+        from horovod_tpu.checkpointing import layout
+        mgr = cp.CheckpointManager(str(tmp_path))
+        mgr.save(3, self._tree(3), async_=False)
+        latest = mgr.drain_for_preemption(step=5, tree=self._tree(5))
+        assert latest == 5
+        assert layout.classify(layout.step_dir(str(tmp_path), 5)) \
+            == layout.COMMITTED
+
+    def test_drain_noop_when_already_committed(self, tmp_path):
+        from horovod_tpu import checkpointing as cp
+        mgr = cp.CheckpointManager(str(tmp_path))
+        tree = self._tree(7)
+        mgr.save(7, tree, async_=False)
+        assert mgr.drain_for_preemption(step=7, tree=tree) == 7
+        assert mgr.all_steps() == [7]
+        # without a (step, tree) it only waits out the queue
+        assert mgr.drain_for_preemption() == 7
+
+    def test_restore_during_drain_and_fallback_walk_past(self, tmp_path):
+        """A restore racing the drain's final save must stay correct, and
+        the drain-written step participates in the normal integrity
+        fallback (corrupt it -> restore walks back past it)."""
+        import numpy as np
+        from horovod_tpu import checkpointing as cp
+        from horovod_tpu.checkpointing import layout
+        mgr = cp.CheckpointManager(str(tmp_path))
+        tree1 = self._tree(1)
+        mgr.save(1, tree1, async_=False)
+        mgr.save(2, self._tree(2), async_=False)
+
+        drainer = threading.Thread(
+            target=mgr.drain_for_preemption,
+            kwargs={"step": 4, "tree": self._tree(4)})
+        drainer.start()
+        out = mgr.restore(step=2, fallback=True)   # concurrent with drain
+        drainer.join(timeout=30)
+        assert not drainer.is_alive()
+        np.testing.assert_array_equal(np.asarray(out["w"]), 2.0)
+        assert mgr.latest_step() == 4
+
+        # corrupt the drain-written step: fallback walks past it
+        step4 = layout.step_dir(str(tmp_path), 4)
+        manifest = layout.read_manifest(step4)
+        shard = os.path.join(step4,
+                             manifest["leaves"][0]["shards"][0]["file"])
+        blob = bytearray(open(shard, "rb").read())
+        blob[0] ^= 0xFF
+        open(shard, "wb").write(bytes(blob))
+        out = mgr.restore(fallback=True)
+        np.testing.assert_array_equal(np.asarray(out["w"]), 2.0)
+
+
+# ---------------------------------------------------------------------------
+# the seeded 2-process preemption drill (real launcher, real workers)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.integration
+def test_preemption_drill_two_proc():
+    """worker.step:preempt:step=3:rank=1:grace=5 under the real elastic
+    launcher: rank 1's host announces its reclaim at its 3rd commit, the
+    driver drains it (never blacklisted, zero heartbeat misses), the
+    survivor restores the committed progress and finishes every epoch at
+    full step count — no epoch lost, none re-run."""
+    from test_elastic_e2e import _events, _finish, _launch
+
+    with tempfile.TemporaryDirectory() as td:
+        proc, _ = _launch(
+            td, "localhost:1\n127.0.0.1:1",
+            extra_env={
+                "HVD_TPU_FAULT_SPEC":
+                    "worker.step:preempt:step=3:rank=1:grace=5",
+                "HVD_TPU_FAULT_SEED": "1234",
+                # hold re-growth: the re-admitted host would otherwise
+                # respawn a fresh rank-1 whose re-parsed spec re-fires the
+                # drill at ITS 3rd commit, forever
+                "HVD_TPU_ELASTIC_SCALE_UP_DELAY": "3600",
+                # pace epochs so the 1 Hz notice/interrupt pipeline lands
+                # with epochs to spare before the fixed count runs out
+                "ELASTIC_TEST_EPOCH_SLEEP": "1.0",
+            },
+            np_=2, min_np=1, epochs=7, extra_args=("--max-np", "2"))
+        code, out = _finish(proc)
+        events = _events(td)
+        assert code == 0, f"launcher exited {code}:\n{out[-6000:]}\n" \
+                          f"events: {events}"
+
+        # graceful drain, by name, exactly once — and re-admittable
+        assert re.search(r"drain of (localhost|127\.0\.0\.1) complete", out), \
+            out[-6000:]
+        assert "draining gracefully" in out
+        # never misdeclared dead, never blacklisted, nobody killed
+        assert "no heartbeat from" not in out
+        assert "-> FAILURE" not in out
+        assert not any(e.startswith("killed") for e in events), events
+
+        # full step count at the shrunken size; rank 1 exits before "done"
+        done = [e for e in events if e.startswith("done ")]
+        assert len(done) == 1, events
+        m = re.search(r"done rank=0 size=(\d+) epochs=(\d+)", done[0])
+        assert m, done
+        assert int(m.group(1)) == 1, done        # drained down to size 1
+        assert int(m.group(2)) == 7, done        # ...but no epoch lost
+
+        # post-drain epochs ran at size 1, and NO epoch was re-run by the
+        # survivor (restored step == last pre-notice commit)
+        rank0_epochs = [int(mm.group(1)) for e in events
+                        for mm in [re.match(r"epoch=(\d+) rank=0 ", e)] if mm]
+        assert sorted(rank0_epochs) == list(range(1, 8)), events
+        assert len(rank0_epochs) == len(set(rank0_epochs)), events
+        shrunk = [e for e in events if re.match(r"epoch=\d+ rank=0 size=1 ",
+                                                e)]
+        assert shrunk, events
