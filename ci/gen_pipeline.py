@@ -42,7 +42,8 @@ COMMON_SUITES = [
      "--ignore=tests/test_fleet.py "
      "--ignore=tests/test_generation.py "
      "--ignore=tests/test_generation_sampling.py "
-     "--ignore=tests/test_generation_prefix.py", 30),
+     "--ignore=tests/test_generation_prefix.py "
+     "--ignore=tests/test_sdc.py", 30),
     ("chaos", "python -m pytest tests/ -q -m chaos "
      "--ignore=tests/test_coordinator_recovery.py "
      "--ignore=tests/test_checkpointing.py "
@@ -51,7 +52,8 @@ COMMON_SUITES = [
      "--ignore=tests/test_fleet.py "
      "--ignore=tests/test_generation.py "
      "--ignore=tests/test_generation_sampling.py "
-     "--ignore=tests/test_generation_prefix.py", 20),
+     "--ignore=tests/test_generation_prefix.py "
+     "--ignore=tests/test_sdc.py", 20),
     # coordinator-kill + heartbeat-timeout drills, seeded so every run
     # replays the same fault schedule; owns its test file exclusively
     # (the generic chaos suite ignores it to avoid double runs)
@@ -98,6 +100,14 @@ COMMON_SUITES = [
      "python -m pytest tests/test_generation.py "
      "tests/test_generation_sampling.py "
      "tests/test_generation_prefix.py -q", 20),
+    # silent-data-corruption defense: the step guard (finite/magnitude +
+    # loss-spike EWMA), cross-replica fingerprints, skip/rollback/
+    # quarantine policy, and the seeded worker.grads bitflip e2e drill
+    # (detect -> roll back -> quarantine -> bit-identical final params)
+    # — pinned seed; owns its file exclusively (unit+chaos ignore it)
+    ("chaos-sdc",
+     "env HVD_TPU_FAULT_SEED=1234 "
+     "python -m pytest tests/test_sdc.py -q", 30),
     ("multiproc",
      "python -m pytest tests/test_multiprocess_integration.py -q", 30),
     ("elastic", "python -m pytest tests/test_elastic_e2e.py -q", 40),

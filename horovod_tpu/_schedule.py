@@ -44,7 +44,8 @@ from . import metrics as _metrics
 
 __all__ = ["record", "ledger", "reset", "divergence_hint",
            "diff_ledgers", "flush_local", "note_divergence",
-           "ScheduleLedger"]
+           "ScheduleLedger", "publish_sdc_fingerprint",
+           "fetch_sdc_fingerprints", "diff_sdc_fingerprints"]
 
 _M_DIVERGENCES = _metrics.counter(
     "hvd_tpu_schedule_divergences_total",
@@ -106,6 +107,11 @@ def reset() -> None:
     with _RESOLVE_LOCK:
         _LEDGER = None
         _RESOLVED = False
+    # the SDC fingerprint client shares the teardown: a new generation
+    # (possibly a new coordinator) must re-resolve the KV endpoint
+    global _sdc_client, _sdc_client_resolved
+    _sdc_client = None
+    _sdc_client_resolved = False
 
 
 def _rank_invariant_fields(entry: tuple) -> tuple:
@@ -337,3 +343,117 @@ def note_divergence() -> None:
     when a hint transitions from empty to set — NOT per hint refresh,
     so a stall persisting many warn windows still counts one event."""
     _M_DIVERGENCES.inc()
+
+
+# ---------------------------------------------------------------------------
+# SDC parameter fingerprints (horovod_tpu/sdc/fingerprint.py) ride the
+# same KV scope as the collective ledger — the stall/divergence plane is
+# where a "rank N disagrees" diagnostic already lives — under their own
+# key prefix, independent of HVD_TPU_SCHEDULE_CHECK (fingerprints have
+# their own knob).
+# ---------------------------------------------------------------------------
+
+_sdc_client = None
+_sdc_client_resolved = False
+
+
+def _sdc_kv_client():
+    """Same single-attempt, short-timeout client recipe as
+    :meth:`ScheduleLedger._kv_client`: a fingerprint publish runs inside
+    the training step cadence, so a dead KV server must cost one bounded
+    probe, never a retry chain."""
+    global _sdc_client, _sdc_client_resolved
+    if not _sdc_client_resolved:
+        from . import config as _config
+        from . import retry as _retry
+        cfg = _config.live_config()
+        addr = cfg.get(_config.RENDEZVOUS_ADDR)
+        port = cfg.get(_config.RENDEZVOUS_PORT)
+        if addr and port and int(port) > 0:
+            from .runner.rendezvous import KVStoreClient
+            _sdc_client = KVStoreClient(
+                addr, int(port), timeout=2.0,
+                retry=_retry.RetryPolicy(
+                    max_attempts=1, initial_backoff=0.05,
+                    max_backoff=0.1, deadline=2.0))
+        _sdc_client_resolved = True
+    return _sdc_client
+
+
+def _env_rank() -> int:
+    from . import basics
+    if basics.is_initialized():
+        return basics.world().rank()
+    import os
+    try:
+        return int(os.environ.get("HVD_TPU_RANK") or 0)
+    except ValueError:
+        return 0
+
+
+def publish_sdc_fingerprint(step: int, fp: int,
+                            rank: Optional[int] = None) -> int:
+    """Best-effort PUT of this rank's parameter fingerprint to the
+    ``schedule`` scope (key ``sdc.fp.rank<r>``). Returns the rank used,
+    so the caller can tell whether a named divergence is its own."""
+    if rank is None:
+        rank = _env_rank()
+    client = _sdc_kv_client()
+    if client is not None:
+        try:
+            client.put("schedule", f"sdc.fp.rank{rank}",
+                       json.dumps({"step": int(step), "fp": int(fp),
+                                   "rank": int(rank)}).encode())
+        except Exception:
+            pass
+    return rank
+
+
+def fetch_sdc_fingerprints(world_size: int) -> Dict[int, dict]:
+    client = _sdc_kv_client()
+    if client is None:
+        return {}
+    out: Dict[int, dict] = {}
+    for r in range(world_size):
+        try:
+            raw = client.get("schedule", f"sdc.fp.rank{r}")
+        except Exception:
+            raw = None
+        if raw:
+            try:
+                out[r] = json.loads(raw.decode())
+            except (ValueError, UnicodeDecodeError):
+                pass
+    return out
+
+
+def diff_sdc_fingerprints(peers: Dict[int, dict],
+                          step: Optional[int] = None
+                          ) -> Optional[Tuple[List[int], str]]:
+    """Name the diverging rank(s) among published fingerprints, majority
+    vote: ``(diverging_ranks, one-line diagnostic)`` or None when the
+    replicas agree. Only entries for ``step`` are compared (peers mid-
+    publish at an older step must not read as divergence)."""
+    at_step = {r: p for r, p in peers.items()
+               if isinstance(p, dict) and "fp" in p
+               and (step is None or p.get("step") == step)}
+    if len(at_step) < 2:
+        return None
+    by_fp: Dict[int, List[int]] = {}
+    for r, p in at_step.items():
+        try:
+            by_fp.setdefault(int(p["fp"]), []).append(r)
+        except (TypeError, ValueError):
+            pass
+    if len(by_fp) <= 1:
+        return None
+    majority_fp = max(by_fp, key=lambda fp: (len(by_fp[fp]),
+                                             -min(by_fp[fp])))
+    diverging = sorted(r for fp, ranks in by_fp.items()
+                       if fp != majority_fp for r in ranks)
+    at = f" at step {step}" if step is not None else ""
+    return diverging, (
+        f"parameter fingerprint divergence{at}: rank(s) "
+        f"{', '.join(map(str, diverging))} disagree with the majority "
+        f"fingerprint 0x{majority_fp:08x} held by "
+        f"{len(by_fp[majority_fp])} rank(s)")

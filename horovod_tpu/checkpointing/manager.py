@@ -185,6 +185,9 @@ class CheckpointManager:
         self._error: Optional[BaseException] = None
         self._lock = _locks.lock("checkpointing.CheckpointManager._lock")
         self._pending_steps: set = set()
+        #: newest step the SDC policy confirmed clean (docs/robustness.md,
+        #: SDC section); None until promote_last_good() is called
+        self.last_good_step: Optional[int] = None
         _MANAGERS.add(self)
 
     # -- world plumbing ------------------------------------------------------
@@ -630,10 +633,44 @@ class CheckpointManager:
                 continue
             if fell_back:
                 _M_FALLBACKS.inc()
+            if fallback:
+                # One summary line on EVERY fallback restore that did not
+                # land on the newest step directory — including the quiet
+                # case where newer steps are PARTIAL (crashed saves) and
+                # so never even entered `candidates`. Operators must be
+                # able to see from the log alone that progress was lost.
+                skipped = [s for s in layout.all_step_dirs(self.directory)
+                           if s > cand]
+                if skipped:
+                    log.warning(
+                        "checkpoint: restored step %d from %s; skipped "
+                        "newer step(s) %s (partial or corrupt)", cand,
+                        self.directory,
+                        ", ".join(str(s) for s in skipped))
             if sharding is not None:
                 import jax
                 tree = jax.device_put(tree, sharding)
             return tree
+
+    # -- last-good (SDC rollback target) -------------------------------------
+
+    def promote_last_good(self, step: int) -> None:
+        """Mark ``step`` as the newest checkpoint that survived the SDC
+        guard for HVD_TPU_SDC_CONFIRM_STEPS subsequent steps — the only
+        step ``restore_last_good`` will consider newest-first from."""
+        self.last_good_step = int(step)
+
+    def restore_last_good(self, target: Any = None, sharding=None) -> Any:
+        """Restore the last-good step (``restore`` with fallback past
+        anything that rotted on disk since the promotion). Raises
+        RuntimeError when nothing was ever promoted — rollback without a
+        confirmed-good target would just reload suspect state."""
+        if self.last_good_step is None:
+            raise RuntimeError(
+                "no last-good checkpoint promoted yet; cannot roll back "
+                f"under {self.directory!r}")
+        return self.restore(step=self.last_good_step, target=target,
+                            sharding=sharding, fallback=True)
 
     def _demote(self, step: int) -> None:
         """Atomically un-commit a corrupt step (idempotent across

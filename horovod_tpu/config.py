@@ -372,6 +372,39 @@ SCHEDULE_CHECK = _register(
          "allreduce('dense_2') where rank 0 submitted "
          "allreduce('dense_1')\") instead of a silent hang. Off by "
          "default (zero overhead); see docs/static_analysis.md.")
+SDC_GUARD = _register(
+    "SDC_GUARD", False, _parse_bool,
+    help="Enable the silent-data-corruption step guard: every optimizer "
+         "step's gradients and loss pass an all-reduced finite check "
+         "plus a loss-spike EWMA bound before the update is applied. A "
+         "tripped guard skips the step (retried once, then dropped), "
+         "counts hvd_tpu_sdc_detections_total, and feeds the rollback/"
+         "quarantine policy. Off by default (zero overhead); see "
+         "docs/robustness.md.")
+SDC_LOSS_SPIKE_FACTOR = _register(
+    "SDC_LOSS_SPIKE_FACTOR", 10.0, float,
+    help="Loss-spike bound for the SDC step guard: a finite loss "
+         "exceeding factor * EWMA(|loss|) counts as a loss_spike "
+         "detection. <= 0 disables the spike bound (finite checks "
+         "remain).")
+SDC_FINGERPRINT_EVERY = _register(
+    "SDC_FINGERPRINT_EVERY", 0, int,
+    help="Compare cross-replica parameter fingerprints (per-leaf bit "
+         "checksum folded into one scalar) every N guarded steps, "
+         "publishing each rank's value to the schedule-ledger KV scope "
+         "so a divergence names the offending rank. 0 (default) "
+         "disables fingerprinting.")
+SDC_CONFIRM_STEPS = _register(
+    "SDC_CONFIRM_STEPS", 2, int,
+    help="A checkpointed step is promoted to last-good (the SDC "
+         "rollback target) only after the step guard has passed this "
+         "many subsequent steps — a corrupted-but-undetected step never "
+         "becomes a rollback target the moment it is written.")
+SDC_STRIKES = _register(
+    "SDC_STRIKES", 3, int,
+    help="SDC detections charged to one host within the policy window "
+         "before it is reported to the elastic driver and quarantined "
+         "(blacklist_host(reason='sdc'), persisted across restarts).")
 RETRY_MAX_ATTEMPTS = _register(
     "RETRY_MAX_ATTEMPTS", 5, int,
     help="Total attempts (first call + retries) for transient host-plane "

@@ -21,6 +21,7 @@ from .. import metrics as _metrics
 from ..runner.hosts import HostInfo, SlotInfo, get_host_assignments
 from .discovery import DiscoveredHosts, HostManager
 from .heartbeat import HeartbeatMonitor
+from ..sdc.report import SDC_SCOPE, decode_report, encode_report
 from .preemption import PREEMPT_SCOPE, decode_notice, encode_notice
 from .registration import WorkerStateRegistry
 from .worker import PUT_WORKER_ADDRESSES, WorkerNotificationClient
@@ -61,6 +62,11 @@ _M_SCALE_EVENTS = _metrics.counter(
     "Deliberate elastic resizes, by direction: 'up' (debounced growth "
     "into new capacity), 'down' (preemption-notice shrink).",
     labels=("direction",))
+_M_QUARANTINED = _metrics.gauge(
+    "hvd_tpu_sdc_quarantined_hosts",
+    "Hosts quarantined for silent data corruption (blacklisted with "
+    "reason 'sdc' after repeated guard/fingerprint strikes; persisted "
+    "across coordinator restarts).")
 
 DISCOVER_HOSTS_FREQUENCY_SECS = 1.0
 
@@ -163,6 +169,10 @@ class ElasticDriver:
         self._assignments_callback: Optional[Callable] = None
         self._worker_clients: Dict[Tuple[str, int],
                                    WorkerNotificationClient] = {}
+
+        #: hosts quarantined for SDC this driver lifetime (gauge source;
+        #: the durable record is the journaled blacklist scope)
+        self._quarantined: set = set()
 
         self._pending_notice_ts: Optional[float] = None
         self._worker_registry = WorkerStateRegistry(
@@ -331,6 +341,48 @@ class ElasticDriver:
                 log.debug("elastic: could not persist preemption notice "
                           "for %s", host, exc_info=True)
 
+    def record_sdc_report(self, host: str, kind: str = "nonfinite",
+                          strikes: int = 1, ts: Optional[float] = None,
+                          persist: bool = True) -> None:
+        """One path in for every SDC quarantine producer — the
+        worker-side policy's PUT (``send_sdc_report``, routed through
+        the rendezvous ``sdc`` scope handler), an operator's HTTP PUT,
+        and journal restore all land here.
+
+        The report already encodes the policy verdict (the worker
+        counted ``strikes`` locally-attributed detections inside its
+        window), so the reaction is immediate: quarantine the host via
+        :meth:`blacklist_host` with ``reason='sdc'`` — which persists
+        to the journaled blacklist scope, unlike a graceful drain, so a
+        flaky chip stays out across coordinator restarts. Idempotent
+        per host.
+
+        ``persist=False`` is used by the rendezvous PUT handler (the
+        report is already in the journaled store) and by journal
+        restore.
+        """
+        if self.finished():
+            return
+        if host in self._quarantined or \
+                self._host_manager.is_blacklisted(host):
+            self._quarantined.add(host)
+            _M_QUARANTINED.set(len(self._quarantined))
+            return
+        log.warning(
+            "elastic: SDC quarantine report for %s (kind=%s, strikes=%d) "
+            "— blacklisting with reason 'sdc' (persisted: a corrupting "
+            "host stays out across restarts)", host, kind, strikes)
+        self._quarantined.add(host)
+        _M_QUARANTINED.set(len(self._quarantined))
+        self.blacklist_host(host, reason="sdc")
+        if persist:
+            try:
+                self._rendezvous.put(SDC_SCOPE, host,
+                                     encode_report(kind, strikes, ts))
+            except Exception:
+                log.debug("elastic: could not persist SDC report for %s",
+                          host, exc_info=True)
+
     def is_draining(self, host: str) -> bool:
         return self._host_manager.is_draining(host)
 
@@ -376,6 +428,15 @@ class ElasticDriver:
                 grace, ts = decode_notice(blob)
                 self.record_preemption_notice(host, grace, ts=ts,
                                               persist=False)
+                count += 1
+        # SDC quarantines re-seed twice over — the blacklist scope above
+        # already re-excluded the host; replaying the sdc scope restores
+        # the quarantine bookkeeping (gauge + reason) behind it.
+        for host, blob in self._rendezvous.items(SDC_SCOPE).items():
+            if host not in self._quarantined:
+                kind, strikes, ts = decode_report(blob)
+                self.record_sdc_report(host, kind, strikes=strikes, ts=ts,
+                                       persist=False)
                 count += 1
         for key, blob in self._rendezvous.items(PUT_WORKER_ADDRESSES).items():
             host, _, local_rank = key.rpartition(":")

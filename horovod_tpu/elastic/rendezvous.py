@@ -10,6 +10,7 @@ register the worker's notification service with the driver.
 import pickle
 
 from ..runner.rendezvous import RendezvousServer
+from ..sdc.report import SDC_SCOPE, decode_report
 from .heartbeat import HEARTBEAT_SCOPE
 from .preemption import PREEMPT_SCOPE, decode_notice
 from .worker import PUT_WORKER_ADDRESSES
@@ -59,3 +60,17 @@ def attach_elastic_handlers(rendezvous: RendezvousServer, driver) -> None:
             record_notice(key, grace, ts=ts, persist=False)
 
         rendezvous.add_put_handler(PREEMPT_SCOPE, put_preemption_notice)
+
+    record_sdc = getattr(driver, "record_sdc_report", None)
+    if record_sdc is not None:
+
+        def put_sdc_report(key: str, value: bytes):
+            # Same one-channel shape as the preemption notice: the
+            # worker-side SDC policy and an operator's HTTP PUT
+            # (curl .../sdc/<host>) both route here. persist=False —
+            # the PUT is already in the journaled store, so a restarted
+            # coordinator replays the quarantine on its own.
+            kind, strikes, ts = decode_report(value)
+            record_sdc(key, kind, strikes=strikes, ts=ts, persist=False)
+
+        rendezvous.add_put_handler(SDC_SCOPE, put_sdc_report)

@@ -174,6 +174,30 @@ class WorkerNotificationManager:
                         hostname, exc_info=True)
             return False
 
+    def send_sdc_report(self, kind: str, strikes: int = 1) -> bool:
+        """PUT a silent-data-corruption quarantine report for THIS
+        worker's host to the journaled ``sdc`` scope — the SDC policy
+        calls it when local detections cross HVD_TPU_SDC_STRIKES
+        (horovod_tpu/sdc/policy.py). Returns True when the report
+        reached the store; False on a non-elastic launch or a delivery
+        failure (best-effort, like the preemption notice: the training
+        loop's skip/rollback reactions do not depend on the driver
+        hearing about the offender)."""
+        with self._lock:
+            client, hostname = self._client, self._hostname
+        if client is None or not hostname:
+            return False
+        from ..sdc.report import SDC_SCOPE, encode_report
+        try:
+            client.put(SDC_SCOPE, hostname, encode_report(kind, strikes))
+            log.warning("elastic: SDC quarantine report sent for %s "
+                        "(kind=%s, strikes=%d)", hostname, kind, strikes)
+            return True
+        except Exception:
+            log.warning("elastic: SDC quarantine report for %s not "
+                        "delivered", hostname, exc_info=True)
+            return False
+
     def register_listener(self, listener) -> None:
         self._listeners.add(listener)
 

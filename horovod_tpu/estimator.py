@@ -12,10 +12,13 @@ allreduce), uses :mod:`horovod_tpu.data` for sharding/prefetch,
 :class:`History`; the fitted estimator predicts locally.
 """
 
+import logging
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional, Sequence
 
 import numpy as np
+
+log = logging.getLogger("horovod_tpu.estimator")
 
 
 @dataclass
@@ -26,6 +29,35 @@ class History:
     def append(self, logs: Dict[str, float]):
         for k, v in logs.items():
             self.history.setdefault(k, []).append(v)
+
+
+class _SdcSentry:
+    """Per-``fit()`` silent-data-corruption defense wiring, built only
+    when ``HVD_TPU_SDC_GUARD`` is on (docs/robustness.md, SDC section):
+    every step runs through the guard, parameters are fingerprinted
+    every ``HVD_TPU_SDC_FINGERPRINT_EVERY`` applied steps, and the
+    policy escalates detections to skip / rollback / quarantine."""
+
+    def __init__(self, manager):
+        from . import sdc as _sdc
+        self.sdc = _sdc
+        self.guard = _sdc.StepGuard()
+        self.monitor = _sdc.FingerprintMonitor()
+        self.policy = _sdc.SdcPolicy()
+        self.manager = manager      # CheckpointManager (rollback target)
+        self.step = 0               # applied (non-skipped) steps
+        self.dropped = 0
+        self.rollbacks = 0
+
+    def safe_loss(self, loss) -> float:
+        # a poisoned step must not leak NaN into the epoch logs (the
+        # metric-average callback allreduces them): report the EWMA,
+        # i.e. the recent clean loss level
+        lv = float(loss)
+        if np.isfinite(lv):
+            return lv
+        ewma = self.guard._ewma
+        return float(ewma) if ewma is not None else 0.0
 
 
 class Estimator:
@@ -123,6 +155,16 @@ class Estimator:
             cb_list.append(CheckpointCallback(self.checkpoint_dir))
         cl = cbs.CallbackList(cb_list, run)
 
+        sentry = None
+        from . import config as _config
+        if _config.live_config().get(_config.SDC_GUARD):
+            manager = None
+            for cb in cb_list:
+                if hasattr(cb, "manager"):       # CheckpointCallback
+                    manager = cb.manager
+                    break
+            sentry = _SdcSentry(manager)
+
         history = History()
         cl.on_train_begin()
         for epoch in range(epochs):
@@ -133,11 +175,17 @@ class Estimator:
             try:
                 for batch, (bx, by) in enumerate(feed):
                     cl.on_batch_begin(batch)
-                    loss, grads = self._loss_and_grads(run.params, bx, by)
-                    updates, self._opt_state = self._opt.update(
-                        grads, self._opt_state, run.params)
-                    run.params = optax.apply_updates(run.params, updates)
-                    logs = {"loss": float(loss)}
+                    if sentry is None:
+                        loss, grads = self._loss_and_grads(
+                            run.params, bx, by)
+                        updates, self._opt_state = self._opt.update(
+                            grads, self._opt_state, run.params)
+                        run.params = optax.apply_updates(run.params,
+                                                         updates)
+                        logs = {"loss": float(loss)}
+                    else:
+                        logs = self._guarded_step(run, bx, by, sentry,
+                                                  optax)
                     cl.on_batch_end(batch, logs)
             finally:
                 feed.close()
@@ -148,6 +196,10 @@ class Estimator:
                 vx, vy = validation_data
                 logs["val_loss"] = float(self._eval_loss(run.params, vx, vy))
             cl.on_epoch_end(epoch, logs)
+            if sentry is not None and "checkpoint_step" in logs:
+                # the save is only a rollback *candidate*: it becomes
+                # last-good after HVD_TPU_SDC_CONFIRM_STEPS clean steps
+                sentry.policy.on_saved(logs["checkpoint_step"])
             history.append(logs)
             if verbose and hvd.rank() == 0:
                 print(f"epoch {epoch}: " + " ".join(
@@ -155,6 +207,62 @@ class Estimator:
         cl.on_train_end(logs if epochs > 0 else None)  # drains async saves
         self.params = run.params
         return history
+
+    # -- SDC defense (docs/robustness.md, SDC section) -----------------------
+    def _guarded_step(self, run, bx, by, sentry, optax) -> Dict[str, float]:
+        """One training step under the SDC guard. A tripped guard skips
+        the poisoned update and retries the batch ONCE (a transient
+        one-shot corruption — the drill, a cosmic-ray flip — recomputes
+        clean, keeping the run bit-identical to an uncorrupted one);
+        a second trip drops the batch. Fingerprint divergence or a
+        repeat pattern escalates to a rollback to last-good."""
+        sdc = sentry.sdc
+        loss = float("nan")
+        for attempt in (0, 1):
+            loss, grads = self._loss_and_grads(run.params, bx, by)
+            grads = sdc.corrupt_grads(grads)     # worker.grads drill site
+            det = sentry.guard.check(grads, loss)
+            if det is None:
+                updates, self._opt_state = self._opt.update(
+                    grads, self._opt_state, run.params)
+                run.params = optax.apply_updates(run.params, updates)
+                sentry.step += 1
+                promoted = sentry.policy.on_clean_step()
+                if promoted is not None and sentry.manager is not None:
+                    sentry.manager.promote_last_good(promoted)
+                fdet = sentry.monitor.maybe_check(sentry.step, run.params)
+                if fdet is not None and \
+                        sentry.policy.on_detection(fdet) == sdc.ROLLBACK:
+                    self._sdc_rollback(run, sentry)
+                return {"loss": sentry.safe_loss(loss)}
+            if sentry.policy.on_detection(det) == sdc.ROLLBACK:
+                self._sdc_rollback(run, sentry)
+                return {"loss": sentry.safe_loss(loss)}
+        sentry.dropped += 1
+        log.warning("sdc: batch dropped — the guard tripped on the "
+                    "retry too (persistent corruption on this input)")
+        return {"loss": sentry.safe_loss(loss)}
+
+    def _sdc_rollback(self, run, sentry) -> None:
+        """Restore params from the last-good checkpoint and reset the
+        optimizer state (it postdates the restored params). Without a
+        promoted last-good target the poisoned update is skipped — a
+        rollback onto unconfirmed state would just reload the suspect
+        parameters it is meant to purge."""
+        mgr = sentry.manager
+        if mgr is None or mgr.last_good_step is None:
+            log.warning("sdc: rollback requested but no last-good "
+                        "checkpoint promoted yet; skipping the poisoned "
+                        "update instead")
+            return
+        mgr.wait_until_finished()
+        run.params = mgr.restore_last_good(target=run.params)
+        self._opt_state = self._opt.init(run.params)
+        self.params = run.params
+        sentry.policy.on_rollback()
+        sentry.rollbacks += 1
+        log.warning("sdc: rolled back to last-good step %d",
+                    mgr.last_good_step)
 
     def _eval_loss(self, params, x, y):
         loss_fn = self.loss_fn or self._default_loss()

@@ -26,6 +26,11 @@ Grammar — ``;``-separated entries, each ``site:field[:field...]``:
                    stall inspector exists to catch;
   - ``crash``      ``os._exit`` — a hard worker kill, the elastic
                    driver's recovery scenario.
+  - ``bitflip``    XOR one mantissa/exponent bit in one tensor leaf —
+                   silent data corruption, delivered through the site's
+                   ``corrupt`` handler (data-carrying sites only);
+  - ``nan``        overwrite one element of one leaf with NaN — the
+                   soft-SDC variant of ``bitflip``, same delivery.
 * remaining ``k=v`` fields scope the rule:
   - ``rate=P``     fire with probability P per hit (default 1.0);
   - ``after=N``    ignore the first N hits of the point;
@@ -74,7 +79,8 @@ _M_INJECTED = _metrics.counter(
 #: a chaos harness can tell an injected kill from an organic failure.
 CRASH_EXIT_CODE = 29
 
-_KINDS = ("error", "neterror", "delay", "hang", "crash", "preempt")
+_KINDS = ("error", "neterror", "delay", "hang", "crash", "preempt",
+          "bitflip", "nan")
 
 
 class InjectedFault(RuntimeError):
@@ -168,7 +174,8 @@ def _parse_entry(entry: str, index: int) -> _Rule:
         if not eq:
             if key == "once":
                 times = 1
-            elif key in ("error", "neterror", "crash", "preempt"):
+            elif key in ("error", "neterror", "crash", "preempt",
+                         "bitflip", "nan"):
                 kind = key
             elif key == "hang":
                 kind, seconds = "hang", 1e9
@@ -313,7 +320,9 @@ class FaultPoint:
         return self._bound
 
     def fire(self, crash: Optional[Callable[[], None]] = None,
-             preempt: Optional[Callable[[float], None]] = None) -> None:
+             preempt: Optional[Callable[[float], None]] = None,
+             corrupt: Optional[Callable[[str, random.Random], None]] = None
+             ) -> None:
         """Inject any matching faults; raises / sleeps / exits per kind.
 
         ``crash``: optional site-owned substitute for ``os._exit`` on
@@ -330,10 +339,18 @@ class FaultPoint:
         owner forwards it into the graceful-drain path. A site without
         a handler ignores the rule (notice kinds only mean something
         where a notice channel exists).
+
+        ``corrupt``: site-owned delivery of silent data corruption on
+        ``bitflip``/``nan`` faults — called with the kind and the bound
+        rule's deterministic RNG so the owner picks the leaf/bit/element
+        reproducibly. Like ``preempt`` this doesn't raise: SDC is by
+        definition silent, the poisoned value flows onward until a guard
+        catches it. A site without a handler ignores the rule (only
+        data-carrying sites can be corrupted).
         """
         if _ACTIVE is None and _configured:
             return  # hot path: injection off
-        err = self._evaluate(crash=crash, preempt=preempt)
+        err = self._evaluate(crash=crash, preempt=preempt, corrupt=corrupt)
         if err is not None:
             raise err
 
@@ -346,8 +363,9 @@ class FaultPoint:
         return self._evaluate() is not None
 
     def _evaluate(self, crash: Optional[Callable[[], None]] = None,
-                  preempt: Optional[Callable[[float], None]] = None
-                  ) -> Optional[BaseException]:
+                  preempt: Optional[Callable[[float], None]] = None,
+                  corrupt: Optional[Callable[[str, random.Random], None]]
+                  = None) -> Optional[BaseException]:
         if not _configured:
             configure()
         reg = _ACTIVE   # one read: rules + seed + gen stay consistent
@@ -372,6 +390,13 @@ class FaultPoint:
                     log.warning(
                         "preempt fault matched site %s but the site has "
                         "no notice handler; ignoring", self.site)
+            elif rule.kind in ("bitflip", "nan"):
+                if corrupt is not None:
+                    corrupt(rule.kind, bound.rng)
+                else:
+                    log.warning(
+                        "%s fault matched site %s but the site has no "
+                        "corrupt handler; ignoring", rule.kind, self.site)
             elif rule.kind == "crash":
                 if crash is not None:
                     crash()

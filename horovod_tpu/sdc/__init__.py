@@ -1,0 +1,31 @@
+"""Silent-data-corruption defense plane.
+
+Crashes, hangs and preemptions are *loud*; a flipped bit is not. This
+package is the loud-making layer (docs/robustness.md, SDC section):
+
+* :mod:`guard` — per-step detection: all-reduced finite checks + a
+  loss-spike EWMA bound (:func:`guard_update` jit-compatible,
+  :class:`StepGuard` eager), and the ``worker.grads`` drill site
+  (:func:`corrupt_grads`);
+* :mod:`fingerprint` — periodic cross-replica parameter checksums
+  published through the schedule-ledger KV scope; a divergence names
+  the offending rank;
+* :mod:`policy` — skip / roll-back-to-last-good / quarantine
+  escalation (:class:`SdcPolicy`);
+* :mod:`report` — the journaled ``sdc`` rendezvous scope codec the
+  worker uses to report a repeat offender to the elastic driver.
+"""
+
+from .fingerprint import (FingerprintMonitor, fingerprint_diverged,  # noqa: F401
+                          fold_fingerprint)
+from .guard import (Detection, StepGuard, corrupt_grads,  # noqa: F401
+                    guard_update)
+from .policy import ROLLBACK, SKIP, SdcPolicy  # noqa: F401
+from .report import SDC_SCOPE, decode_report, encode_report  # noqa: F401
+
+__all__ = [
+    "Detection", "StepGuard", "corrupt_grads", "guard_update",
+    "FingerprintMonitor", "fingerprint_diverged", "fold_fingerprint",
+    "SdcPolicy", "SKIP", "ROLLBACK",
+    "SDC_SCOPE", "encode_report", "decode_report",
+]

@@ -1,0 +1,105 @@
+"""Cross-replica parameter fingerprints.
+
+Data-parallel replicas hold (by construction) bit-identical parameters:
+every update is the same allreduced gradient applied to the same state.
+A replica whose parameters drift — a bit flipped *after* the guard's
+gradient check, a corrupted optimizer slot, bad HBM — is invisible to
+loss monitoring until the model is already poisoned. The fingerprint
+closes that window: every ``HVD_TPU_SDC_FINGERPRINT_EVERY`` guarded
+steps each rank folds its parameter tree into one uint32 checksum
+(:func:`fold_fingerprint` — a bit-sensitive FNV-style fold over the raw
+float bits, ~one pass over the params) and publishes it to the PR 8
+schedule-ledger KV scope. A mismatch names the diverging rank(s) by
+majority vote — the same diagnostic shape as the collective-divergence
+ledger — and :class:`FingerprintMonitor` turns it into a ``fingerprint``
+detection for the rollback/quarantine policy.
+"""
+
+import logging
+from typing import Optional
+
+import numpy as np
+
+from .. import _schedule
+from .. import config as _config
+from .guard import _M_DETECTIONS, Detection
+
+log = logging.getLogger("horovod_tpu.sdc")
+
+#: FNV-1a constants — the fold must be cheap, deterministic, and
+#: sensitive to any single flipped bit (a plain value sum is not: two
+#: compensating errors cancel; the multiply diffuses every word)
+_FNV_OFFSET = np.uint32(2166136261)
+_FNV_PRIME = np.uint32(16777619)
+
+
+def fold_fingerprint(tree) -> int:
+    """One uint32 checksum over every inexact leaf's raw bits. Works on
+    host numpy and jax arrays alike; leaf order is the pytree order, so
+    identical trees fold identically on every rank."""
+    import jax
+
+    acc = _FNV_OFFSET
+    with np.errstate(over="ignore"):
+        for i, leaf in enumerate(jax.tree_util.tree_leaves(tree)):
+            a = np.asarray(leaf)
+            if not np.issubdtype(a.dtype, np.inexact) or a.size == 0:
+                continue
+            bits = np.ascontiguousarray(a.astype(np.float32)).view(np.uint32)
+            s = np.uint32(np.sum(bits, dtype=np.uint64) & 0xFFFFFFFF)
+            acc = np.uint32((acc ^ s) * _FNV_PRIME + np.uint32(i))
+    return int(acc)
+
+
+def fingerprint_diverged(fp, axis_name: str):
+    """Jit-compatible divergence flag: True when replicas along
+    ``axis_name`` disagree on the fingerprint scalar ``fp``."""
+    import jax
+    import jax.numpy as jnp
+
+    fp = jnp.asarray(fp, jnp.uint32)
+    return jax.lax.pmax(fp, axis_name) != jax.lax.pmin(fp, axis_name)
+
+
+class FingerprintMonitor:
+    """Periodic publish-and-compare through the schedule-ledger KV scope.
+
+    ``maybe_check(step, params)`` is a no-op except every
+    ``HVD_TPU_SDC_FINGERPRINT_EVERY``-th step (and always when the KV
+    store is unreachable — single-process runs keep a local-only
+    fingerprint). On a mismatch it returns a :class:`Detection` of kind
+    ``fingerprint`` whose ``local`` flag says whether THIS rank is in
+    the diverging minority (the one the quarantine policy charges).
+    """
+
+    def __init__(self, every: Optional[int] = None):
+        self.every = int(_config.live_config().get(
+            _config.SDC_FINGERPRINT_EVERY)) if every is None else int(every)
+
+    def maybe_check(self, step: int, params) -> Optional[Detection]:
+        if self.every <= 0 or step % self.every != 0:
+            return None
+        fp = fold_fingerprint(params)
+        rank = _schedule.publish_sdc_fingerprint(step, fp)
+        size = _world_size()
+        if size < 2:
+            return None
+        peers = _schedule.fetch_sdc_fingerprints(size)
+        diverged = _schedule.diff_sdc_fingerprints(peers, step)
+        if diverged is None:
+            return None
+        ranks, msg = diverged
+        _M_DETECTIONS.labels(kind="fingerprint").inc()
+        log.warning("sdc: %s", msg)
+        return Detection(kind="fingerprint", local=rank in ranks)
+
+
+def _world_size() -> int:
+    from .. import basics
+    if basics.is_initialized():
+        return basics.size()
+    import os
+    try:
+        return int(os.environ.get("HVD_TPU_SIZE") or 1)
+    except ValueError:
+        return 1

@@ -1,0 +1,223 @@
+"""SDC step guard: finite/magnitude checks, loss-spike bound, and the
+``worker.grads`` corruption site.
+
+Detection layer of the SDC defense plane (docs/robustness.md). Two
+surfaces share the same math:
+
+* :func:`guard_update` — jit-compatible: traces into a step function,
+  all-reduces the verdict over ``axis_name`` so every replica agrees on
+  the same step;
+* :class:`StepGuard` — the eager/host-side variant the Estimator loop
+  uses (its loss is already a host float per batch); the verdict is
+  synchronized with a MAX allreduce across processes so every rank
+  skips or rolls back the same step.
+
+The ``worker.grads`` fault point is the deterministic drill entry: a
+``bitflip``/``nan`` rule corrupts one element of one gradient leaf via
+:func:`corrupt_grads`, exactly what a flaky chip would do silently.
+"""
+
+import logging
+from typing import Callable, NamedTuple, Optional
+
+import numpy as np
+
+from .. import config as _config
+from .. import faults as _faults
+from .. import metrics as _metrics
+
+log = logging.getLogger("horovod_tpu.sdc")
+
+_M_DETECTIONS = _metrics.counter(
+    "hvd_tpu_sdc_detections_total",
+    "Silent-data-corruption detections, by kind: 'nonfinite' (NaN/Inf "
+    "gradient or loss), 'loss_spike' (finite loss beyond the EWMA "
+    "bound), 'fingerprint' (cross-replica parameter fingerprint "
+    "divergence).",
+    labels=("kind",))
+
+# Chaos site for silent data corruption: fired once per guarded step on
+# the freshly computed LOCAL gradients (before the allreduce would
+# spread the poison). ``worker.grads:bitflip:step=N`` XORs one
+# mantissa/exponent bit of one leaf element at the N-th step;
+# ``worker.grads:nan:step=N`` overwrites one element with NaN. Leaf,
+# element and bit all come from the rule's seeded RNG — the same seed
+# replays the identical corruption on every run.
+_FP_GRADS = _faults.FaultPoint("worker.grads")
+
+#: EWMA smoothing for the loss-spike bound (the bound tracks the recent
+#: loss scale, not the full history, so LR-warmup drift stays in bound)
+_EWMA_ALPHA = 0.1
+
+#: verdict codes shared by the jit and eager guards (MAX-reduced, so
+#: the hard failure wins when replicas disagree on the kind)
+_OK, _SPIKE, _NONFINITE = 0, 1, 2
+_KIND_BY_CODE = {_SPIKE: "loss_spike", _NONFINITE: "nonfinite"}
+
+#: any float32 gradient beyond this is physically impossible in a run
+#: whose loss is still finite — it is corruption, the same class as
+#: NaN/Inf. The bound matters because the canonical SDC event (one
+#: flipped exponent bit) multiplies a value by ~2^128 and usually stays
+#: *finite*: isfinite() alone would wave it through.
+GRAD_ABS_LIMIT = 1e12
+
+#: elements below this are numerically zero; the bitflip drill skips
+#: them so the flipped magnitude (x * 2^128) always clears the limit
+_DRILL_FLOOR = 1e-20
+
+
+def _corrupt_array(a: np.ndarray, kind: str, rng) -> np.ndarray:
+    out = np.array(a, copy=True)
+    flat = out.reshape(-1)
+    if kind == "nan":
+        flat[rng.randrange(flat.size)] = np.nan
+        return out
+    # bitflip: XOR the top exponent bit of one non-negligible element —
+    # the classic silent-corruption signature: the value explodes by
+    # ~2^128 yet usually stays finite, so isfinite() alone misses it
+    # (GRAD_ABS_LIMIT is the matching detector). Degenerate all-zero
+    # leaves fall back to a NaN overwrite: flipping a zero's exponent
+    # yields 2.0, indistinguishable from a legitimate gradient.
+    candidates = np.flatnonzero(np.abs(flat) >= _DRILL_FLOOR)
+    if candidates.size == 0:
+        flat[rng.randrange(flat.size)] = np.nan
+        return out
+    idx = int(candidates[rng.randrange(candidates.size)])
+    nbits = out.dtype.itemsize * 8
+    uint = np.dtype(f"u{out.dtype.itemsize}")
+    view = flat.view(uint)
+    view[idx] ^= uint.type(1) << uint.type(nbits - 2)
+    return out
+
+
+def corrupt_grads(grads):
+    """Fire the ``worker.grads`` site; a matched ``bitflip``/``nan``
+    rule returns a corrupted copy of ``grads`` (one element of one
+    float leaf, chosen by the rule's seeded RNG), otherwise ``grads``
+    unchanged. Call on the local gradients before they are reduced —
+    that is where a real SDC event enters the step."""
+    box = [grads]
+
+    def handler(kind: str, rng) -> None:
+        import jax
+        leaves, treedef = jax.tree_util.tree_flatten(box[0])
+        targets = [i for i, l in enumerate(leaves)
+                   if np.issubdtype(np.asarray(l).dtype, np.floating)
+                   and np.asarray(l).size > 0]
+        if not targets:
+            return
+        i = targets[rng.randrange(len(targets))]
+        corrupted = _corrupt_array(np.asarray(leaves[i]), kind, rng)
+        leaves[i] = jax.device_put(corrupted)
+        box[0] = jax.tree_util.tree_unflatten(treedef, leaves)
+
+    _FP_GRADS.fire(corrupt=handler)
+    return box[0]
+
+
+def guard_update(grads, loss, ewma=None, factor: Optional[float] = None,
+                 axis_name: Optional[str] = None):
+    """Jit-compatible step guard: ``(code, new_ewma)``.
+
+    ``code`` is an int32 scalar — 0 (clean), 1 (loss spike), 2
+    (non-finite or out-of-range gradient, or non-finite loss) —
+    already MAX-reduced over
+    ``axis_name`` when given, so every replica takes the same branch.
+    ``new_ewma`` advances the loss EWMA only on clean steps (a poisoned
+    loss must not widen its own bound). Pass ``ewma=None`` on the first
+    step (the spike bound warms up from the first clean loss)."""
+    import jax
+    import jax.numpy as jnp
+
+    if factor is None:
+        factor = float(
+            _config.live_config().get(_config.SDC_LOSS_SPIKE_FACTOR))
+    loss = jnp.asarray(loss, jnp.float32)
+    bad = ~jnp.isfinite(loss)
+    for leaf in jax.tree_util.tree_leaves(grads):
+        leaf = jnp.asarray(leaf)
+        if jnp.issubdtype(leaf.dtype, jnp.inexact):
+            # one reduction per leaf: max(|x|) propagates NaN and Inf,
+            # so ~(m <= limit) catches all three corruption shapes
+            # (NaN, Inf, out-of-range) in a single pass over the data
+            m = jnp.max(jnp.abs(leaf.astype(jnp.float32)))
+            bad = bad | ~(m <= GRAD_ABS_LIMIT)
+    code = jnp.where(bad, jnp.int32(_NONFINITE), jnp.int32(_OK))
+    if ewma is None:
+        new_ewma = jnp.abs(loss)
+    else:
+        ewma = jnp.asarray(ewma, jnp.float32)
+        if factor > 0:
+            spike = jnp.abs(loss) > factor * jnp.maximum(ewma, 1e-12)
+            code = jnp.maximum(
+                code, jnp.where(spike, jnp.int32(_SPIKE), jnp.int32(_OK)))
+        new_ewma = (1.0 - _EWMA_ALPHA) * ewma + _EWMA_ALPHA * jnp.abs(loss)
+    if axis_name is not None:
+        code = jax.lax.pmax(code, axis_name)
+    new_ewma = jnp.where(code > 0, ewma if ewma is not None else new_ewma,
+                         new_ewma)
+    return code, new_ewma
+
+
+class Detection(NamedTuple):
+    kind: str      # "nonfinite" | "loss_spike" | "fingerprint"
+    local: bool    # True when THIS rank's data tripped the guard
+
+
+class StepGuard:
+    """Eager step guard for the host-side training loop.
+
+    ``check(grads, loss)`` returns a :class:`Detection` when the step
+    is poisoned, else None. The verdict is MAX-allreduced across
+    processes (when initialized), so all ranks agree; ``local`` tells
+    the quarantine policy whether to charge the strike to this host.
+    """
+
+    def __init__(self, loss_spike_factor: Optional[float] = None,
+                 sync: Optional[Callable[[int], int]] = None):
+        cfg = _config.live_config()
+        self.factor = float(cfg.get(_config.SDC_LOSS_SPIKE_FACTOR)) \
+            if loss_spike_factor is None else float(loss_spike_factor)
+        self._sync = sync if sync is not None else _sync_verdict
+        self._ewma: Optional[float] = None
+
+    def check(self, grads, loss) -> Optional[Detection]:
+        import jax
+        loss = float(loss)
+        local = _NONFINITE if not np.isfinite(loss) else _OK
+        if local == _OK:
+            for leaf in jax.tree_util.tree_leaves(grads):
+                a = np.asarray(leaf)
+                if not np.issubdtype(a.dtype, np.inexact):
+                    continue
+                if not np.all(np.isfinite(a)) or (
+                        a.size and float(np.max(np.abs(
+                            a.astype(np.float32)))) > GRAD_ABS_LIMIT):
+                    local = _NONFINITE
+                    break
+        if local == _OK and self._ewma is not None and self.factor > 0 \
+                and abs(loss) > self.factor * max(self._ewma, 1e-12):
+            local = _SPIKE
+        code = self._sync(local)
+        if code == _OK:
+            self._ewma = abs(loss) if self._ewma is None else \
+                (1.0 - _EWMA_ALPHA) * self._ewma + _EWMA_ALPHA * abs(loss)
+            return None
+        kind = _KIND_BY_CODE[code]
+        _M_DETECTIONS.labels(kind=kind).inc()
+        log.warning("sdc: step guard tripped (%s%s) — loss=%r, "
+                    "ewma=%r", kind, "" if local else " on a peer rank",
+                    loss, self._ewma)
+        return Detection(kind=kind, local=local != _OK)
+
+
+def _sync_verdict(code: int) -> int:
+    """MAX-allreduce the local verdict code so every rank skips (or
+    rolls back) the same step; identity in single-process runs."""
+    from .. import basics
+    if not basics.is_initialized() or basics.size() <= 1:
+        return code
+    from .. import collectives as _c
+    return int(np.asarray(_c.allreduce(
+        np.asarray([code], np.int32), name="sdc.guard.verdict",
+        op=_c.Max))[0])

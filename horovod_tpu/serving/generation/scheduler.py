@@ -169,6 +169,30 @@ _M_STEP = _metrics.histogram(
 _FP_PREFILL = _faults.FaultPoint("serving.prefill")
 _FP_DECODE = _faults.FaultPoint("serving.decode")
 _FP_EVICT = _faults.FaultPoint("serving.evict")
+# SDC drill for the generation plane: a ``nan`` rule poisons ONE live
+# lane's logprob after the device step — the blast-radius contract
+# (docs/robustness.md, SDC section) is that exactly that sequence
+# fails; its batchmates keep decoding.
+_FP_LOGPROB = _faults.FaultPoint("serving.logprob")
+
+
+def _corrupt_logprobs(logp: np.ndarray, lanes) -> np.ndarray:
+    """Fire the ``serving.logprob`` site; a matched ``nan``/``bitflip``
+    rule returns a copy with ONE live decode lane's logprob poisoned
+    (seeded pick), otherwise ``logp`` unchanged."""
+    box = [logp]
+
+    def handler(kind: str, rng) -> None:
+        live = [i for i, s in enumerate(lanes)
+                if s is not None and s.state == "decode"]
+        if not live:
+            return
+        out = np.array(box[0], copy=True)
+        out[live[rng.randrange(len(live))]] = np.nan
+        box[0] = out
+
+    _FP_LOGPROB.fire(corrupt=handler)
+    return box[0]
 
 #: chunk width of the decode program: one live token plus one pad
 #: column. Width 1 would trip XLA's matrix-vector specializations,
@@ -740,6 +764,12 @@ class ContinuousBatcher:
                 t0 = time.perf_counter()
                 tok_v, logp_v = np.asarray(tok), np.asarray(logp)
                 self._blocked_s += time.perf_counter() - t0
+                logp_v = _corrupt_logprobs(logp_v, [s])
+                if not np.isfinite(logp_v[0]):
+                    self._deliver_error(s, RuntimeError(
+                        f"non-finite logprob for sequence {s.id}: "
+                        f"silent data corruption in the prefill step"))
+                    return
                 self._emit(s, int(tok_v[0]), float(logp_v[0]), now)
         if self.on_step is not None:
             self.on_step("prefill", [s.id])
@@ -904,11 +934,19 @@ class ContinuousBatcher:
             self._reset_device()
             return
         self._blocked_s += time.perf_counter() - t0
+        logp = _corrupt_logprobs(logp, lanes)   # serving.logprob drill
         emitted = []
         for i, s in enumerate(lanes):
             # a lane retired by an earlier flight had live=0 on device
             # for this one: no token was produced, nothing to mirror
             if s is None or s.state != "decode":
+                continue
+            if not np.isfinite(logp[i]):
+                # silent-data-corruption blast radius: exactly this
+                # sequence fails; its batchmates keep their tokens
+                self._deliver_error(s, RuntimeError(
+                    f"non-finite logprob for sequence {s.id}: silent "
+                    f"data corruption in the decode step"))
                 continue
             s.cache_len += 1
             if s.cache_len % self._alloc.block_size == 0:

@@ -1,7 +1,7 @@
 #!/usr/bin/env python
 """Collective-plane microbenchmark driver (VERDICT r3 item 2).
 
-Runs five sections, each in killable CPU subprocesses, and writes
+Runs six sections, each in killable CPU subprocesses, and writes
 ``MICROBENCH.json``:
 
 1. ``eager_1proc``  — payload sweep of the eager plane with one process:
@@ -35,10 +35,16 @@ Runs five sections, each in killable CPU subprocesses, and writes
    on vs off over the same compiled programs (outputs asserted
    identical), reporting tokens/sec, prefilled tokens, and the cache
    hit/miss/eviction counters.
+6. ``sdc``          — SDC defense-plane overhead (docs/robustness.md)
+   on the ResNet-50 161-gradient scenario: a jit'd update plain vs with
+   the step guard fused in, plus the cross-replica parameter
+   fingerprint fold amortized at ``fingerprint_every=20``; the
+   guard-on/off step-time delta is the cost of ``HVD_TPU_SDC_GUARD``
+   (target <2% where the guard's reductions fuse into the update pass).
 
 Usage: ``python microbench.py [--quick]``. Workers are internal
 (``--worker-eager`` / ``--worker-scaling`` / ``--worker-injit`` /
-``--worker-generation``).
+``--worker-generation`` / ``--worker-sdc``).
 """
 
 import json
@@ -213,6 +219,32 @@ def _run_generation(quick: bool, timeout: int):
     return rows or None
 
 
+def worker_sdc(quick: bool) -> int:
+    from horovod_tpu.microbench import sdc_guard_sweep
+    row = sdc_guard_sweep(steps=20 if quick else 40,
+                          rounds=2 if quick else 3)
+    print(MB_TAG + json.dumps(row))
+    return 0
+
+
+def _run_sdc(quick: bool, timeout: int):
+    cmd = [sys.executable, os.path.abspath(__file__), "--worker-sdc"]
+    if quick:
+        cmd.append("--quick")
+    try:
+        p = subprocess.run(cmd, env=_cpu_env(), text=True,
+                           capture_output=True, timeout=timeout)
+    except subprocess.TimeoutExpired:
+        _log("sdc: timeout")
+        return None
+    sys.stderr.write(p.stderr or "")
+    if p.returncode != 0:
+        _log(f"sdc: rc={p.returncode}")
+        return None
+    rows = _collect(p.stdout or "")
+    return rows[0] if rows else None
+
+
 def _run_injit(n: int, quick: bool, timeout: int):
     env = _cpu_env({
         "XLA_FLAGS": f"--xla_force_host_platform_device_count={n}",
@@ -247,6 +279,8 @@ def main():
             return worker_injit(int(a.split("=", 1)[1]), quick)
         if a == "--worker-generation":
             return worker_generation(quick)
+        if a == "--worker-sdc":
+            return worker_sdc(quick)
 
     t0 = time.time()
     result = {"quick": quick}
@@ -258,15 +292,15 @@ def main():
         bk = next((r for r in rows if "scenario" in r), None)
         return plain, bk
 
-    _log("section 1/5: eager sweep, 1 process")
+    _log("section 1/6: eager sweep, 1 process")
     result["eager_1proc"], result["bucketed_1proc"] = split_bucketed(
         _run_eager(1, quick, timeout=600))
 
-    _log("section 2/5: eager sweep, 2 processes")
+    _log("section 2/6: eager sweep, 2 processes")
     result["eager_2proc"], result["bucketed_2proc"] = split_bucketed(
         _run_eager(2, quick, timeout=900))
 
-    _log("section 3/5: compiled-plane scaling sweep")
+    _log("section 3/6: compiled-plane scaling sweep")
     points = []
     for n in (1, 2, 4, 8):
         row = _run_scaling(n, quick, timeout=600)
@@ -281,7 +315,7 @@ def main():
                 / (p["num_devices"] * base["images_per_sec_total"]), 3)
     result["scaling"] = points
 
-    _log("section 4/5: in-jit fast path (ResNet-50 gradient scenario)")
+    _log("section 4/6: in-jit fast path (ResNet-50 gradient scenario)")
     injit_rows = []
     for n in ((1, 2) if quick else (1, 2, 8)):
         row = _run_injit(n, quick, timeout=900)
@@ -303,7 +337,7 @@ def main():
                  f"(x{row['packed_speedup_vs_per_leaf']} vs per-leaf)")
     result["injit"] = injit_rows
 
-    _log("section 5/5: continuous vs static batch generation + sampling")
+    _log("section 5/6: continuous vs static batch generation + sampling")
     gen_rows = _run_generation(quick, timeout=1200)
     gen = gen_rows[0] if gen_rows else None
     sampling = gen_rows[1] if gen_rows and len(gen_rows) > 1 else None
@@ -328,6 +362,17 @@ def main():
     result["generation"] = gen
     result["generation_sampling"] = sampling
     result["generation_prefix"] = prefix
+
+    _log("section 6/6: SDC guard + fingerprint overhead")
+    sdc = _run_sdc(quick, timeout=600)
+    if sdc:
+        _log(f"  guard on/off: {sdc['guarded_ms_per_step']} vs "
+             f"{sdc['plain_ms_per_step']} ms/step "
+             f"({sdc['overhead_pct']}% on {sdc['platform']}, target "
+             f"<{sdc['target_pct']}%), fingerprint fold "
+             f"{sdc['fingerprint_fold_ms']} ms every "
+             f"{sdc['fingerprint_every']} steps")
+    result["sdc"] = sdc
     result["wall_s"] = round(time.time() - t0, 1)
 
     out_path = os.path.join(ROOT, "MICROBENCH.json")
@@ -367,6 +412,9 @@ def main():
         if prefix else None,
         "gen_prefix_prefill_reduction": prefix["prefill_reduction"]
         if prefix else None,
+        "sdc_guard_overhead_pct": sdc["overhead_pct"] if sdc else None,
+        "sdc_fingerprint_fold_ms": sdc["fingerprint_fold_ms"]
+        if sdc else None,
     }))
     return 0
 

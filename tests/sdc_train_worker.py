@@ -1,0 +1,84 @@
+"""Worker script for the seeded 2-process SDC drill (tests/test_sdc.py).
+
+Each process trains the same tiny model data-parallel under the SDC
+guard. With ``HVD_TPU_FAULT_SPEC=worker.grads:bitflip:step=3:rank=1``
+the drill corrupts rank 1's local gradients once; the guard's
+MAX-allreduced verdict makes BOTH ranks skip and retry that step, so
+the final parameters must be bit-identical to an uninjected run's.
+When ``HVD_TPU_RENDEZVOUS_ADDR`` points at the parent's KV store, the
+worker registers its notification channel and the SDC policy's
+quarantine report (``HVD_TPU_SDC_STRIKES=1``) lands in the journaled
+``sdc`` scope for the parent to verify.
+
+Prints, per rank: ``PARAMS rank=R <sha256>``, ``DETECTIONS rank=R N``,
+and ``sdc worker R OK`` on success.
+"""
+
+import hashlib
+import os
+import sys
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ.pop("XLA_FLAGS", None)
+os.environ.setdefault("HVD_TPU_SDC_GUARD", "1")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+import flax.linen as nn  # noqa: E402
+import optax  # noqa: E402
+
+import horovod_tpu as hvd  # noqa: E402
+from horovod_tpu import metrics as M  # noqa: E402
+from horovod_tpu.estimator import Estimator  # noqa: E402
+
+
+class Net(nn.Module):
+    @nn.compact
+    def __call__(self, x):
+        x = nn.relu(nn.Dense(16)(x))
+        return nn.Dense(4)(x)
+
+
+def main():
+    hvd.init()
+    rank = hvd.rank()
+
+    elastic = bool(os.environ.get("HVD_TPU_RENDEZVOUS_ADDR"))
+    if elastic:
+        from horovod_tpu.elastic.worker import notification_manager
+        notification_manager.init()
+
+    # identical data on every rank (shard=False): with SGD (stateless)
+    # the allreduced updates keep the replicas bit-identical, so any
+    # divergence is the corruption itself
+    rng = np.random.RandomState(7)
+    x = rng.randn(64, 8).astype(np.float32)
+    y = (np.arange(64) % 4).astype(np.int32)
+
+    est = Estimator(Net(), optimizer=optax.sgd(1e-2), seed=3,
+                    scale_lr_by_world=False)
+    est.fit(x, y, epochs=int(os.environ.get("SDC_TEST_EPOCHS", "2")),
+            batch_size=16, shard=False)
+
+    digest = hashlib.sha256()
+    for leaf in jax.tree_util.tree_leaves(est.params):
+        digest.update(np.ascontiguousarray(np.asarray(leaf)).tobytes())
+    detections = sum(
+        float(v) for k, v in M.snapshot().items()
+        if k.startswith("hvd_tpu_sdc_detections_total"))
+
+    print(f"PARAMS rank={rank} {digest.hexdigest()}", flush=True)
+    print(f"DETECTIONS rank={rank} {int(detections)}", flush=True)
+
+    if elastic:
+        from horovod_tpu.elastic.worker import notification_manager
+        notification_manager.shutdown()
+    print(f"sdc worker {rank} OK", flush=True)
+
+
+if __name__ == "__main__":
+    main()
+    sys.exit(0)

@@ -411,6 +411,46 @@ class TestIntegrity:
         assert layout.classify(step2) == layout.PARTIAL
         assert mgr.latest_step() == 1
 
+    def test_fallback_restore_warns_naming_skipped_steps(self, tmp_path):
+        """SDC-satellite contract: a fallback restore that lands below
+        the newest step directory emits ONE warning naming every
+        skipped step — including the quiet happy path where the newer
+        steps are PARTIAL (crashed saves) and never even entered the
+        candidate list, so no per-candidate fallback warning fires."""
+        import logging
+
+        # capture at the source logger: once any test has run
+        # hvd.init(), the repo's logging setup puts its own handler on
+        # "horovod_tpu" with propagate=False, so caplog sees nothing
+        records = []
+
+        class _Tap(logging.Handler):
+            def emit(self, record):
+                records.append(record)
+
+        mgr = cp.CheckpointManager(str(tmp_path))
+        mgr.save(1, {"w": jnp.arange(4, dtype=jnp.float32)}, async_=False)
+        mgr.save(2, {"w": jnp.ones(4, jnp.float32)}, async_=False)
+        mgr.save(3, {"w": jnp.ones(4, jnp.float32)}, async_=False)
+        # demote steps 2 and 3 to PARTIAL: crashed saves newer than the
+        # step the restore will silently land on
+        for s in (2, 3):
+            os.unlink(os.path.join(layout.step_dir(str(tmp_path), s),
+                                   layout.COMMIT_NAME))
+        src = logging.getLogger("horovod_tpu.checkpointing")
+        tap = _Tap(logging.WARNING)
+        src.addHandler(tap)
+        try:
+            out = mgr.restore(fallback=True)
+        finally:
+            src.removeHandler(tap)
+        np.testing.assert_array_equal(np.asarray(out["w"]), np.arange(4))
+        msgs = [r.getMessage() for r in records
+                if "skipped newer step(s)" in r.getMessage()]
+        assert len(msgs) == 1
+        assert "restored step 1" in msgs[0]
+        assert "2, 3" in msgs[0]
+
     def test_torn_manifest_detected_by_commit_crc(self, tmp_path):
         mgr = cp.CheckpointManager(str(tmp_path))
         mgr.save(1, {"w": jnp.zeros(4)}, async_=False)

@@ -209,6 +209,22 @@ def test_generation_suite_is_seeded_and_exclusive():
         assert os.path.exists(os.path.join(root, *fname.split("/")))
 
 
+def test_chaos_sdc_suite_is_seeded_and_exclusive():
+    """The silent-data-corruption drills (step guard, fingerprints,
+    skip/rollback/quarantine policy, 2-proc bitflip e2e drill) run as
+    their own seeded CI suite; the generic unit and chaos suites must
+    not run the same file twice."""
+    by_name = {name: cmd for name, cmd, _t in COMMON_SUITES}
+    assert "chaos-sdc" in by_name
+    cmd = by_name["chaos-sdc"]
+    assert "HVD_TPU_FAULT_SEED=" in cmd
+    assert "tests/test_sdc.py" in cmd
+    assert "--ignore=tests/test_sdc.py" in by_name["unit"]
+    assert "--ignore=tests/test_sdc.py" in by_name["chaos"]
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    assert os.path.exists(os.path.join(root, "tests", "test_sdc.py"))
+
+
 def test_lint_static_suite_in_every_service():
     """The unified static-analysis suite (tools/analyze: lock-discipline,
     lock-order, contract lints, jit-purity, knobs, plus the

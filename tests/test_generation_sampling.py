@@ -399,3 +399,58 @@ class TestSamplingAdmission:
             assert _post_gen(srv.port, {"prompt": prompt,
                                         "top_k": "x"})[0] == 400
         gen.close()
+
+
+# ---------------------------------------------------------------------------
+# SDC blast radius: a poisoned logprob fails ONE sequence, not the batch
+# ---------------------------------------------------------------------------
+
+class TestSdcBlastRadius:
+    def test_nan_logprob_drill_fails_exactly_one_sequence(
+            self, model_params):
+        """Seeded ``serving.logprob`` nan drill (docs/robustness.md, SDC
+        section): the poisoned lane's sequence errors with a message
+        naming the corruption; every batchmate finishes greedy-exact;
+        all blocks return to the pool."""
+        model, params, ref = model_params
+        rng = np.random.RandomState(50)
+        before = M.snapshot()
+        F.configure("serving.logprob:nan:once", seed=SEED)
+        prompts = [_prompt(rng, 4) for _ in range(3)]
+        results = []
+        with _engine(model, params, max_seqs=4) as eng:
+            seqs = [eng.submit(p, max_tokens=6) for p in prompts]
+            for s in seqs:
+                try:
+                    results.append(("ok", eng.result(s, timeout=240)))
+                except RuntimeError as e:
+                    assert "silent data corruption" in str(e)
+                    results.append(("err", None))
+            assert eng.allocator.in_use == 0
+        assert sum(1 for st, _ in results if st == "err") == 1
+        for i, (st, out) in enumerate(results):
+            if st == "ok":
+                assert out == _greedy_reference(ref, params, prompts[i], 6)
+        assert _delta(before, 'hvd_tpu_faults_injected_total'
+                              '{site="serving.logprob",kind="nan"}') == 1
+
+    def test_nan_logprob_drill_is_one_500_on_the_wire(self, model_params):
+        """The same drill through the HTTP front end: the poisoned
+        request is a 500 naming the corruption; the next request on the
+        same engine is a clean 200 — corruption never outlives the
+        sequence it hit."""
+        model, params, _ = model_params
+        rng = np.random.RandomState(51)
+        prompt = _prompt(rng, 4)
+        F.configure("serving.logprob:nan:once", seed=SEED)
+        gen = _engine(model, params)
+        with serving.InferenceServer(engine=None, gen_engine=gen,
+                                     port=0, addr="127.0.0.1") as srv:
+            code, out = _post_gen(srv.port, {"prompt": prompt,
+                                             "max_tokens": 4})
+            assert code == 500
+            assert "silent data corruption" in out["error"]
+            code, out = _post_gen(srv.port, {"prompt": prompt,
+                                             "max_tokens": 4})
+            assert code == 200 and len(out["tokens"]) == 4
+        gen.close()

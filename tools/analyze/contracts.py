@@ -27,7 +27,8 @@ from .core import Context, Finding, checker
 #: mirrors horovod_tpu/faults.py ``_KINDS`` plus the bare param forms
 _SPEC_ENTRY = re.compile(
     r"^([A-Za-z_][A-Za-z0-9_.]*)\s*:\s*"
-    r"(error|neterror|crash|delay=[-0-9.e]+|hang(=[-0-9.e]+)?)"
+    r"(error|neterror|crash|preempt|bitflip|nan"
+    r"|delay=[-0-9.e]+|hang(=[-0-9.e]+)?)"
     r"(:[A-Za-z0-9_.=-]+)*$")
 
 
