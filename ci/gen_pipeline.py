@@ -43,7 +43,8 @@ COMMON_SUITES = [
      "--ignore=tests/test_generation.py "
      "--ignore=tests/test_generation_sampling.py "
      "--ignore=tests/test_generation_prefix.py "
-     "--ignore=tests/test_sdc.py", 30),
+     "--ignore=tests/test_sdc.py "
+     "--ignore=tests/test_tracing.py", 30),
     ("chaos", "python -m pytest tests/ -q -m chaos "
      "--ignore=tests/test_coordinator_recovery.py "
      "--ignore=tests/test_checkpointing.py "
@@ -53,7 +54,8 @@ COMMON_SUITES = [
      "--ignore=tests/test_generation.py "
      "--ignore=tests/test_generation_sampling.py "
      "--ignore=tests/test_generation_prefix.py "
-     "--ignore=tests/test_sdc.py", 20),
+     "--ignore=tests/test_sdc.py "
+     "--ignore=tests/test_tracing.py", 20),
     # coordinator-kill + heartbeat-timeout drills, seeded so every run
     # replays the same fault schedule; owns its test file exclusively
     # (the generic chaos suite ignores it to avoid double runs)
@@ -108,6 +110,14 @@ COMMON_SUITES = [
     ("chaos-sdc",
      "env HVD_TPU_FAULT_SEED=1234 "
      "python -m pytest tests/test_sdc.py -q", 30),
+    # per-request distributed tracing: span lifecycle + propagation
+    # units, the zero-overhead-when-disabled contract, exemplar linkage,
+    # the bounded record writer, the tools.trace merger, and the seeded
+    # 2-proc router->replica->collective drill — pinned seed; owns its
+    # file exclusively (unit+chaos suites ignore it)
+    ("observability",
+     "env HVD_TPU_FAULT_SEED=1234 "
+     "python -m pytest tests/test_tracing.py -q", 30),
     ("multiproc",
      "python -m pytest tests/test_multiprocess_integration.py -q", 30),
     ("elastic", "python -m pytest tests/test_elastic_e2e.py -q", 40),

@@ -46,6 +46,7 @@ from . import faults as _faults
 from . import metrics as _metrics
 from . import retry as _retry
 from . import timeline as _tl
+from . import tracing as _tracing
 from .exceptions import HorovodInternalError, TensorValidationError
 from .tensor_table import Handle, TensorTable, metadata_fingerprint
 
@@ -1378,6 +1379,11 @@ def _record_round(w, entry, pset=None) -> None:
     # join markers are part of the cross-rank schedule even though the
     # replay log below excludes them. A no-op when the ledger is off.
     _sched.record(entry, pset)
+    # request tracer (HVD_TPU_TRACE_SAMPLE, tracing.py): when the
+    # submitting thread is working for a sampled request, the trace
+    # gets a span naming this collective's verb + tensor name. A no-op
+    # guard otherwise.
+    _tracing.collective(entry)
     if entry[1].startswith(("hvd.join.", "horovod_tpu.join.")):
         return
     log = getattr(w, "_join_round_log", None)

@@ -105,6 +105,31 @@ TIMELINE = _register(
 TIMELINE_MARK_CYCLES = _register(
     "TIMELINE_MARK_CYCLES", False, _parse_bool,
     alias="HOROVOD_TIMELINE_MARK_CYCLES")
+TIMELINE_QUEUE_EVENTS = _register(
+    "TIMELINE_QUEUE_EVENTS", 65536, int,
+    help="Bound on the timeline/tracer record queue (records, not "
+         "bytes). A slow or dead disk drops records beyond this — "
+         "counted in hvd_tpu_timeline_dropped_total — instead of "
+         "growing the queue without bound. 0 = unbounded (the "
+         "pre-hardening behavior).")
+TRACE_SAMPLE = _register(
+    "TRACE_SAMPLE", 0.0, float,
+    help="Head-based sampling rate for the per-request distributed "
+         "tracer ([tracing](timeline.md)): the fraction of request ids "
+         "traced, decided deterministically from a hash of the id so "
+         "the fleet router and every replica rank make the same call "
+         "with zero coordination. 0 (default) disables tracing "
+         "entirely — the hot-path guard is one module-global load per "
+         "call site, the timeline.py discipline. 1 traces every "
+         "request.")
+TRACE_DIR = _register(
+    "TRACE_DIR", "", str,
+    help="Directory for the tracer's per-process span files "
+         "(spans-rank<N>.jsonl, one JSON span per line); `python -m "
+         "tools.trace` merges all ranks' files into one cross-host "
+         "chrome://tracing timeline for a request id. Unset keeps "
+         "spans in the in-memory ring only (still publishable to the "
+         "rendezvous 'trace' KV scope on live fleets).")
 
 # -- Stall inspector (reference: stall_inspector.h:75-80) --------------------
 STALL_CHECK_DISABLE = _register(

@@ -175,7 +175,7 @@ class Histogram:
     (one atomic bucket add + CAS sum add) when built."""
 
     __slots__ = ("_nat", "_h", "_ready", "_lock", "_bounds", "_counts",
-                 "_sum", "_count", "_registry")
+                 "_sum", "_count", "_registry", "_exemplar")
 
     def __init__(self, registry: "Registry", buckets: Sequence[float]):
         self._registry = registry
@@ -190,6 +190,7 @@ class Histogram:
         self._counts = [0] * (len(self._bounds) + 1)
         self._sum = 0.0
         self._count = 0
+        self._exemplar = None
 
     def _resolve(self) -> None:
         with self._lock:
@@ -209,12 +210,20 @@ class Histogram:
             except Exception:
                 pass
 
-    def observe(self, value: float) -> None:
+    def observe(self, value: float, exemplar: Optional[str] = None) -> None:
         if not self._registry.enabled:
             return
         if not self._ready:
             self._resolve()
         v = float(value)
+        if exemplar:
+            # trace-id exemplar (OpenMetrics-style): the most recent
+            # traced observation, kept Python-side on BOTH backends so a
+            # p99 outlier links to its request trace regardless of the
+            # native fast path. Last-writer-wins under the GIL; the text
+            # exposition stays 0.0.4 (exemplars are a scrape-format
+            # feature, this is a debugging handle).
+            self._exemplar = (str(exemplar), v)
         if self._h is not None:
             self._nat.cdll.hvd_hist_observe(self._h, v)
             return
@@ -224,6 +233,11 @@ class Histogram:
             self._counts[idx] += 1
             self._sum += v
             self._count += 1
+
+    def exemplar(self) -> Optional[Tuple[str, float]]:
+        """(trace id, observed value) of the most recent observation
+        that carried one, or None."""
+        return self._exemplar
 
     def read(self) -> Tuple[Tuple[int, ...], float, int]:
         """(per-bucket counts incl. +Inf, sum, count) — non-cumulative."""
@@ -311,8 +325,8 @@ class Family:
     def dec(self, amount: float = 1.0) -> None:
         self._children[()].dec(amount)
 
-    def observe(self, value: float) -> None:
-        self._children[()].observe(value)
+    def observe(self, value: float, exemplar: Optional[str] = None) -> None:
+        self._children[()].observe(value, exemplar=exemplar)
 
     def get(self):
         return self._children[()].get()

@@ -898,3 +898,82 @@ def sdc_guard_sweep(steps: int = 40, rounds: int = 3,
         if plain_ms > 0 else None,
         "target_pct": 2.0,
     }
+
+
+def tracing_overhead_sweep(requests: int = 20000, rounds: int = 3) -> dict:
+    """Per-request cost of the distributed tracer (docs/timeline.md) on
+    the serving hot path's instrumentation sequence — one root
+    ``request_span``, one nested span, one retroactive ``emit_span``,
+    and one ``collective`` hook per request (the four call-site shapes
+    the router/batcher/scheduler wiring added) — measured with
+    ``HVD_TPU_TRACE_SAMPLE=0`` (the shipped default: every call site
+    must reduce to the module-global no-op guard) and ``=1`` (every
+    request traced into the in-memory ring; no span file). The ``off``
+    delta over the bare loop is the acceptance number: tracing disabled
+    must be within noise of not instrumenting at all."""
+    import os
+
+    from . import tracing
+
+    rids = [f"{i:016x}" for i in range(requests)]
+    entry = ("allreduce", "grad_0", (1024,), "float32")
+
+    def run_bare():
+        for _ in range(requests):
+            t = time.monotonic()
+            assert t
+
+    def run_traced():
+        for rid in rids:
+            with tracing.request_span("server.generate", rid):
+                with tracing.span("gen.prefill"):
+                    tracing.collective(entry)
+                t = time.monotonic()
+                tracing.emit_span(tracing.current(), "gen.decode", t, t)
+
+    def set_rate(rate):
+        os.environ["HVD_TPU_TRACE_SAMPLE"] = rate
+        tracing.reset()
+
+    prior = os.environ.get("HVD_TPU_TRACE_SAMPLE")
+    try:
+        # interleaved A/B/C rounds, best-round estimates (eager_sweep)
+        t_bare = t_off = t_on = float("inf")
+        for _ in range(max(rounds, 2) + 1):  # first round doubles as warmup
+            t0 = time.perf_counter()
+            run_bare()
+            t_bare = min(t_bare, time.perf_counter() - t0)
+            set_rate("0")
+            assert tracing.tracer() is None
+            t0 = time.perf_counter()
+            run_traced()
+            t_off = min(t_off, time.perf_counter() - t0)
+            set_rate("1")
+            assert tracing.tracer() is not None
+            t0 = time.perf_counter()
+            run_traced()
+            t_on = min(t_on, time.perf_counter() - t0)
+    finally:
+        if prior is None:
+            os.environ.pop("HVD_TPU_TRACE_SAMPLE", None)
+        else:
+            os.environ["HVD_TPU_TRACE_SAMPLE"] = prior
+        tracing.reset()
+
+    bare_us = t_bare / requests * 1e6
+    off_us = t_off / requests * 1e6
+    on_us = t_on / requests * 1e6
+    return {
+        "scenario": "request_tracing_overhead",
+        "requests_timed": requests,
+        "call_sites_per_request": 4,
+        "spans_per_request_on": 4,
+        "bare_us_per_req": round(bare_us, 4),
+        "off_us_per_req": round(off_us, 4),
+        "on_us_per_req": round(on_us, 4),
+        # what HVD_TPU_TRACE_SAMPLE=0 costs over no instrumentation
+        "off_overhead_us_per_req": round(off_us - bare_us, 4),
+        # what turning tracing ON costs over leaving it off
+        "on_overhead_us_per_req": round(on_us - off_us, 4),
+        "on_over_off": round(on_us / off_us, 2) if off_us > 0 else None,
+    }

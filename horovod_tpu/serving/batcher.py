@@ -44,6 +44,7 @@ from .. import config as _config
 from .. import data as _data
 from .. import faults as _faults
 from .. import metrics as _metrics
+from .. import tracing as _tracing
 
 _M_QUEUE_DEPTH = _metrics.gauge(
     "hvd_tpu_serving_queue_depth",
@@ -187,7 +188,7 @@ class _Request:
     the result)."""
 
     __slots__ = ("x", "n", "deadline", "enqueued_at", "event", "result",
-                 "error", "meta")
+                 "error", "meta", "trace")
 
     def __init__(self, x: np.ndarray, deadline: float):
         self.x = x
@@ -198,6 +199,10 @@ class _Request:
         self.result = None
         self.error: Optional[BaseException] = None
         self.meta = None
+        # the submitting thread's trace context (None unless the request
+        # is sampled): the batcher thread emits this request's queue-wait
+        # and forward spans under it
+        self.trace = _tracing.current()
 
 
 _STOP = object()
@@ -450,7 +455,14 @@ class MicroBatcher:
     def _dispatch(self, batch, rows: int) -> None:
         now = time.monotonic()
         for r in batch:
-            _M_LATENCY.labels(phase="queue").observe(now - r.enqueued_at)
+            # traced requests stamp their trace id as the histogram
+            # exemplar, linking a latency outlier to its full timeline
+            _M_LATENCY.labels(phase="queue").observe(
+                now - r.enqueued_at,
+                exemplar=r.trace.trace_id if r.trace is not None else None)
+            if r.trace is not None:
+                _tracing.emit_span(r.trace, "batch.queue", r.enqueued_at,
+                                   now, args={"rows": r.n})
         _M_BATCH_SIZE.observe(rows)
         try:
             _FP_BATCH.fire()
@@ -463,8 +475,17 @@ class MicroBatcher:
             out, meta = res if (isinstance(res, tuple) and len(res) == 2) \
                 else (res, None)
             out = np.asarray(out)
+            t1 = time.monotonic()
+            traced = [r for r in batch if r.trace is not None]
             _M_LATENCY.labels(phase="forward").observe(
-                time.monotonic() - t0)
+                t1 - t0,
+                exemplar=traced[0].trace.trace_id if traced else None)
+            for r in traced:
+                # one forward span per traced request sharing the batch:
+                # each request's timeline shows the whole fused forward
+                _tracing.emit_span(r.trace, "batch.forward", t0, t1,
+                                   args={"rows": rows,
+                                         "batched_requests": len(batch)})
         except BaseException as e:  # noqa: BLE001 — surfaced per request
             if isinstance(e, ValueError):
                 # a batch-time ValueError is a SERVER-side failure for

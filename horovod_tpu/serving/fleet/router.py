@@ -45,7 +45,6 @@ import threading
 import time
 import urllib.error
 import urllib.request
-import uuid
 from typing import Dict, Iterable, Mapping, Optional, Tuple, Union
 
 from ... import _http
@@ -54,6 +53,7 @@ from ... import config as _config
 from ... import faults as _faults
 from ... import metrics as _metrics
 from ... import retry as _retry
+from ... import tracing as _tracing
 from ...elastic.heartbeat import HeartbeatSender, LivenessMonitor
 from .tenancy import FairScheduler, TenantQuotaError, TenantRegistry
 from ..batcher import DeadlineExceededError
@@ -145,6 +145,10 @@ class _RouterHandler(_http.QuietHandler):
 
     def _send(self, code: int, doc: dict,
               request_id: Optional[str] = None) -> None:
+        if request_id and code >= 400 and "request_id" not in doc:
+            # error bodies carry the request id too: a client that lost
+            # the headers (proxies, log scrapers) can still correlate
+            doc = dict(doc, request_id=request_id)
         body = json.dumps(doc).encode("utf-8")
         _M_REQUESTS.labels(code=str(code)).inc()
         try:
@@ -446,7 +450,7 @@ class FleetRouter:
 
     def _proxy(self, handler: _RouterHandler, path: str) -> None:
         request_id = handler.headers.get(REQUEST_ID_HEADER) \
-            or uuid.uuid4().hex[:16]
+            or _tracing.new_request_id()
         try:
             length = int(handler.headers.get("Content-Length", 0))
             body = handler.rfile.read(length)
@@ -454,38 +458,47 @@ class FleetRouter:
             handler._send(400, {"error": "bad request body"}, request_id)
             return
         tenant = self.tenants.resolve(handler.headers)
-        if self._routable_count == 0:
-            # a fully-unroutable fleet fails fast: queueing at zero
-            # capacity would burn the client's deadline to say less
-            log.warning("fleet: request %s (tenant %s): no routable "
-                        "replica", request_id, tenant.name)
-            handler._send(503, {"error": "no routable replicas"},
-                          request_id)
-            return
-        deadline_ts = None
-        deadline_ms = handler.headers.get("X-HVD-TPU-Deadline-Ms")
-        if deadline_ms is None:
-            deadline_ms = _config.live_config().get(
-                _config.SERVING_DEADLINE_MS)
-        try:
-            if float(deadline_ms) > 0:
-                deadline_ts = time.monotonic() + float(deadline_ms) / 1e3
-        except (TypeError, ValueError):
-            pass
-        try:
-            self.scheduler.acquire(tenant, deadline_ts=deadline_ts)
-        except TenantQuotaError as e:
-            handler._send(429, {"error": str(e), "tenant": tenant.name},
-                          request_id)
-            return
-        except DeadlineExceededError as e:
-            handler._send(429, {"error": str(e), "tenant": tenant.name},
-                          request_id)
-            return
-        try:
-            self._forward(handler, path, body, request_id, tenant.name)
-        finally:
-            self.scheduler.release(tenant)
+        # the root span of a traced request's cross-host timeline: every
+        # downstream hop (admission, replica server, batcher, collective)
+        # nests under it via the propagated context
+        with _tracing.request_span("router.route", request_id,
+                                   args={"path": path,
+                                         "tenant": tenant.name}):
+            if self._routable_count == 0:
+                # a fully-unroutable fleet fails fast: queueing at zero
+                # capacity would burn the client's deadline to say less
+                log.warning("fleet: request %s (tenant %s): no routable "
+                            "replica", request_id, tenant.name)
+                handler._send(503, {"error": "no routable replicas"},
+                              request_id)
+                return
+            deadline_ts = None
+            deadline_ms = handler.headers.get("X-HVD-TPU-Deadline-Ms")
+            if deadline_ms is None:
+                deadline_ms = _config.live_config().get(
+                    _config.SERVING_DEADLINE_MS)
+            try:
+                if float(deadline_ms) > 0:
+                    deadline_ts = time.monotonic() \
+                        + float(deadline_ms) / 1e3
+            except (TypeError, ValueError):
+                pass
+            try:
+                with _tracing.span("router.admission",
+                                   args={"tenant": tenant.name}):
+                    self.scheduler.acquire(tenant, deadline_ts=deadline_ts)
+            except TenantQuotaError as e:
+                handler._send(429, {"error": str(e), "tenant": tenant.name},
+                              request_id)
+                return
+            except DeadlineExceededError as e:
+                handler._send(429, {"error": str(e), "tenant": tenant.name},
+                              request_id)
+                return
+            try:
+                self._forward(handler, path, body, request_id, tenant.name)
+            finally:
+                self.scheduler.release(tenant)
 
     def _forward(self, handler: _RouterHandler, path: str, body: bytes,
                  request_id: str, tenant_name: str) -> None:
@@ -505,10 +518,16 @@ class FleetRouter:
                 handler._send(503, {"error": "no routable replicas"},
                               request_id)
                 return
+            headers = {"Content-Type": "application/json",
+                       REQUEST_ID_HEADER: request_id}
+            ctx = _tracing.current()
+            if ctx is not None:
+                # sampled request: hand the replica our span as parent so
+                # its server span nests under this proxy hop
+                headers[_tracing.TRACE_PARENT_HEADER] = ctx.encode()
             req = urllib.request.Request(
                 replica.base_url + path, data=body, method="POST",
-                headers={"Content-Type": "application/json",
-                         REQUEST_ID_HEADER: request_id})
+                headers=headers)
             try:
                 with urllib.request.urlopen(
                         req, timeout=self._request_timeout) as resp:

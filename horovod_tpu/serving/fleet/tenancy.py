@@ -37,6 +37,7 @@ from typing import Callable, Deque, Dict, Optional, Tuple
 
 from ... import config as _config
 from ... import metrics as _metrics
+from ... import tracing as _tracing
 from ..batcher import DeadlineExceededError
 
 TENANT_HEADER = "X-HVD-TPU-Tenant"
@@ -228,7 +229,11 @@ class FairScheduler:
                 self._cond.wait(timeout=wait_s)
         waited = time.monotonic() - start
         _M_ADMITTED.labels(tenant=tenant.name).inc()
-        _M_QUEUE_WAIT.labels(tenant=tenant.name).observe(waited)
+        # a traced request stamps its trace id as the exemplar, so a
+        # queue-wait outlier links straight to its cross-host timeline
+        ctx = _tracing.current()
+        _M_QUEUE_WAIT.labels(tenant=tenant.name).observe(
+            waited, exemplar=ctx.trace_id if ctx is not None else None)
 
     def release(self, tenant: Tenant) -> None:
         with self._cond:

@@ -108,7 +108,8 @@ class GenerationEngine:
                temperature: Optional[float] = None,
                top_k: Optional[int] = None,
                top_p: Optional[float] = None,
-               seed: Optional[int] = None) -> GenSequence:
+               seed: Optional[int] = None,
+               request_id: Optional[str] = None) -> GenSequence:
         """Admit one request; returns the sequence handle for
         :meth:`result` / :meth:`stream`. Raises ``QueueFullError``
         (503) / ``DeadlineExceededError`` (429) / ``ValueError``
@@ -116,11 +117,14 @@ class GenerationEngine:
         runs on device: ``temperature`` (None/0 = greedy), ``top_k``,
         ``top_p``, and ``seed`` (deterministic continuations, also
         across a preemption-recompute) — see
-        :meth:`ContinuousBatcher.submit`."""
+        :meth:`ContinuousBatcher.submit`. ``request_id`` stamps the
+        serving request id onto the sequence for preemption/deadline
+        attribution and per-request tracing."""
         return self.batcher.submit(prompt, max_tokens=max_tokens,
                                    eos_id=eos_id, deadline_ms=deadline_ms,
                                    temperature=temperature, top_k=top_k,
-                                   top_p=top_p, seed=seed)
+                                   top_p=top_p, seed=seed,
+                                   request_id=request_id)
 
     def result(self, seq: GenSequence,
                timeout: Optional[float] = None) -> List[int]:

@@ -109,6 +109,7 @@ from ... import _locks
 from ... import config as _config
 from ... import faults as _faults
 from ... import metrics as _metrics
+from ... import tracing as _tracing
 from ...models.transformer import PagedCache
 from ..batcher import DeadlineExceededError, QueueFullError
 from .kv_cache import (BlockAllocator, BlocksExhaustedError, DecodeState,
@@ -226,12 +227,13 @@ class GenSequence:
                  "resume_decode", "state", "error", "stream_q",
                  "done_event", "arrived_at", "temperature", "top_k",
                  "top_p", "seed", "key", "prefix_hashes", "block_hashes",
-                 "cache_gen")
+                 "cache_gen", "request_id", "trace")
 
     def __init__(self, seq_id: int, prompt: List[int], max_tokens: int,
                  eos_id: Optional[int], deadline_s: float,
                  temperature: float = 0.0, top_k: int = 0,
-                 top_p: float = 1.0, seed: Optional[int] = None):
+                 top_p: float = 1.0, seed: Optional[int] = None,
+                 request_id: Optional[str] = None):
         self.id = seq_id
         self.prompt = list(prompt)
         self.max_tokens = int(max_tokens)
@@ -279,6 +281,13 @@ class GenSequence:
         self.stream_q: "queue.Queue" = queue.Queue()
         self.done_event = threading.Event()
         self.arrived_at = time.monotonic()
+        #: serving request id, stamped into preemption/deadline
+        #: diagnostics whether or not the request is traced
+        self.request_id = request_id
+        #: the submitting request's TraceContext when it is sampled
+        #: (tracing.py); the scheduler thread emits prefill/decode/
+        #: preempt spans against it
+        self.trace = _tracing.current()
 
 
 class ContinuousBatcher:
@@ -388,7 +397,8 @@ class ContinuousBatcher:
                temperature: Optional[float] = None,
                top_k: Optional[int] = None,
                top_p: Optional[float] = None,
-               seed: Optional[int] = None) -> GenSequence:
+               seed: Optional[int] = None,
+               request_id: Optional[str] = None) -> GenSequence:
         """Admit one generation request. Raises
         :class:`~horovod_tpu.serving.batcher.QueueFullError` on a full
         queue (HTTP 503), ``ValueError`` for a request that could never
@@ -453,7 +463,8 @@ class ContinuousBatcher:
         seq = GenSequence(next(self._ids), prompt, max_tokens,
                           self.eos_id if eos_id is None else eos_id,
                           ddl_s, temperature=temperature, top_k=top_k,
-                          top_p=top_p, seed=seed)
+                          top_p=top_p, seed=seed, request_id=request_id)
+        _tracing.note_request(request_id)
         if self._prefix_cache:
             # hashed on the submitter's thread (pure computation on a
             # sequence the scheduler can't see yet) so the hot loop
@@ -657,7 +668,8 @@ class ContinuousBatcher:
             self._waiting.remove(s)
             self._deliver_error(s, DeadlineExceededError(
                 f"deadline expired before sequence {s.id} could "
-                f"{'resume' if s.resume_decode else 'start'}"))
+                f"{'resume' if s.resume_decode else 'start'}"
+                + (f" (request {s.request_id})" if s.request_id else "")))
         while self._waiting:
             s = self._waiting[0]
             if len(self._running) >= self.max_seqs:
@@ -705,7 +717,8 @@ class ContinuousBatcher:
             if s.state != "done":
                 self._deliver_error(s, DeadlineExceededError(
                     f"deadline expired before sequence {s.id}'s next "
-                    f"token"))
+                    f"token"
+                    + (f" (request {s.request_id})" if s.request_id else "")))
 
     def _prefill_step(self, now: float) -> None:
         self._expire_running(now)
@@ -735,9 +748,18 @@ class ContinuousBatcher:
             top_p=jnp.asarray([s.top_p], jnp.float32),
             key=jnp.asarray(s.key[None, :]),
             emitted=jnp.zeros((1,), jnp.int32))
+        if s.request_id:
+            _tracing.note_request(s.request_id)
         try:
-            _FP_PREFILL.fire()
-            tok, logp = self._run_prefill(s, tokens, live, sample)
+            # the span installs the request's context on the scheduler
+            # thread, so collectives submitted inside the prefill program
+            # bind under this chunk
+            with _tracing.span_for(s.trace, "gen.prefill",
+                                   args={"seq": s.id, "chunk": live,
+                                         "prefilled": s.prefilled,
+                                         "total": total}):
+                _FP_PREFILL.fire()
+                tok, logp = self._run_prefill(s, tokens, live, sample)
         except Exception as e:  # noqa: BLE001 — fails only this sequence
             self._deliver_error(s, e)
             return
@@ -1096,12 +1118,30 @@ class ContinuousBatcher:
                 self._epoch += 1
         self._waiting.insert(0, s)
         _M_PREEMPTIONS.inc()
+        if s.trace is not None:
+            t = time.monotonic()
+            _tracing.emit_span(s.trace, "gen.preempt", t, t,
+                               args={"seq": s.id,
+                                     "generated": len(s.generated)})
+        import logging
+        logging.getLogger("horovod_tpu").info(
+            "preempted sequence %s%s: KV blocks freed, requeued at the "
+            "front of the waiting line in recompute mode", s.id,
+            f" (request {s.request_id})" if s.request_id else "")
 
     def _emit(self, s: GenSequence, token: int, logprob: float,
               now: float) -> None:
         s.generated.append(token)
         s.logprobs.append(logprob)
         s.next_input = token
+        if s.trace is not None:
+            # one instant span per emitted token — the decode-step
+            # analogue of the per-chunk prefill span (the guard is a
+            # single is-None test for untraced sequences)
+            t = time.monotonic()
+            _tracing.emit_span(s.trace, "gen.decode", t, t,
+                               args={"seq": s.id,
+                                     "token_index": len(s.generated)})
         if s.deadline_s > 0:
             s.deadline = now + s.deadline_s
         s.stream_q.put(token)

@@ -51,6 +51,7 @@ import numpy as np
 from .. import _http
 from .. import config as _config
 from .. import metrics as _metrics
+from .. import tracing as _tracing
 from .batcher import DeadlineExceededError, QueueFullError
 from .engine import InferenceEngine
 
@@ -72,18 +73,30 @@ REQUEST_ID_HEADER = "X-HVD-TPU-Request-Id"
 
 class _ServingHandler(_http.QuietHandler):
     def _request_id(self):
-        return self.headers.get(REQUEST_ID_HEADER)
+        # generate an id server-side when the client sent none, so every
+        # response — including 4xx/5xx — carries a quotable id; cached
+        # per request (do_GET/do_POST clear it: keep-alive reuses the
+        # handler instance across requests)
+        rid = getattr(self, "_rid", None)
+        if rid is None:
+            rid = self.headers.get(REQUEST_ID_HEADER) or \
+                _tracing.new_request_id()
+            self._rid = rid
+        return rid
 
     def _respond(self, code: int, doc: dict) -> None:
+        rid = self._request_id()
+        if code >= 400 and "request_id" not in doc:
+            # error bodies quote the id too: a client that dropped the
+            # response headers can still report a traceable failure
+            doc = dict(doc, request_id=rid)
         body = json.dumps(doc).encode("utf-8")
         _M_REQUESTS.labels(code=str(code)).inc()
         try:
             self.send_response(code)
             self.send_header("Content-Type", "application/json")
             self.send_header("Content-Length", str(len(body)))
-            rid = self._request_id()
-            if rid:
-                self.send_header(REQUEST_ID_HEADER, rid)
+            self.send_header(REQUEST_ID_HEADER, rid)
             self.end_headers()
             self.wfile.write(body)
         except OSError:
@@ -91,6 +104,7 @@ class _ServingHandler(_http.QuietHandler):
             self.close_connection = True
 
     def do_GET(self):  # noqa: N802 — BaseHTTPRequestHandler contract
+        self._rid = None
         if self.path.split("?", 1)[0] != "/healthz":
             self._respond(404, {"error": "not found"})
             return
@@ -109,6 +123,7 @@ class _ServingHandler(_http.QuietHandler):
         self._respond(200, doc)
 
     def do_POST(self):  # noqa: N802
+        self._rid = None
         path = self.path.split("?", 1)[0]
         if path == "/v1/infer":
             self._infer()
@@ -160,27 +175,32 @@ class _ServingHandler(_http.QuietHandler):
         except (ValueError, KeyError, TypeError) as e:
             self._respond(400, {"error": f"bad request: {e}"})
             return
-        try:
-            out, step = engine.infer_with_step(
-                x, deadline_ms=doc.get("deadline_ms"))
-        except QueueFullError as e:
-            self._respond(503, {"error": str(e)})
-            return
-        except DeadlineExceededError as e:
-            self._respond(429, {"error": str(e)})
-            return
-        except ValueError as e:         # oversized request, bad rank
-            self._respond(400, {"error": str(e)})
-            return
-        except Exception as e:  # noqa: BLE001 — forward failure -> 500
-            log.warning("serving: forward failed for one batch "
-                        "(request %s): %s", self._request_id(), e)
-            self._respond(500, {"error": str(e)})
-            return
-        # step comes back with the batch result: it names the checkpoint
-        # that PRODUCED these outputs, even if a hot-swap landed since
-        self._respond(200, {"outputs": np.asarray(out).tolist(),
-                            "step": step})
+        with _tracing.request_span(
+                "server.infer", self._request_id(),
+                parent=self.headers.get(_tracing.TRACE_PARENT_HEADER),
+                args={"rows": len(x)}):
+            try:
+                out, step = engine.infer_with_step(
+                    x, deadline_ms=doc.get("deadline_ms"))
+            except QueueFullError as e:
+                self._respond(503, {"error": str(e)})
+                return
+            except DeadlineExceededError as e:
+                self._respond(429, {"error": str(e)})
+                return
+            except ValueError as e:         # oversized request, bad rank
+                self._respond(400, {"error": str(e)})
+                return
+            except Exception as e:  # noqa: BLE001 — forward failure -> 500
+                log.warning("serving: forward failed for one batch "
+                            "(request %s): %s", self._request_id(), e)
+                self._respond(500, {"error": str(e)})
+                return
+            # step comes back with the batch result: it names the
+            # checkpoint that PRODUCED these outputs, even if a hot-swap
+            # landed since
+            self._respond(200, {"outputs": np.asarray(out).tolist(),
+                                "step": step})
 
     def _generate(self) -> None:
         gen = self.server.gen_engine
@@ -208,33 +228,41 @@ class _ServingHandler(_http.QuietHandler):
         # scheduler delivers after admission — even a ValueError out of
         # the device program — is a server-side 500, so the two phases
         # are caught separately
-        try:
-            seq = gen.submit(prompt, max_tokens=max_tokens, eos_id=eos_id,
-                             deadline_ms=doc.get("deadline_ms"),
-                             temperature=temperature, top_k=top_k,
-                             top_p=top_p, seed=seed)
-        except QueueFullError as e:
-            self._respond(503, {"error": str(e)})
-            return
-        except DeadlineExceededError as e:
-            self._respond(429, {"error": str(e)})
-            return
-        except ValueError as e:   # could-never-fit, bad sampling params
-            self._respond(400, {"error": str(e)})
-            return
-        try:
-            tokens = gen.result(seq)
-        except DeadlineExceededError as e:
-            self._respond(429, {"error": str(e)})
-            return
-        except Exception as e:  # noqa: BLE001 — decode failure -> 500
-            log.warning("serving: generation failed for one sequence "
-                        "(request %s): %s", self._request_id(), e)
-            self._respond(500, {"error": str(e)})
-            return
-        self._respond(200, {"tokens": tokens,
-                            "logprobs": [round(x, 6) for x in seq.logprobs],
-                            "step": gen.step})
+        with _tracing.request_span(
+                "server.generate", self._request_id(),
+                parent=self.headers.get(_tracing.TRACE_PARENT_HEADER),
+                args={"prompt_tokens": len(prompt),
+                      "max_tokens": max_tokens}):
+            try:
+                seq = gen.submit(prompt, max_tokens=max_tokens,
+                                 eos_id=eos_id,
+                                 deadline_ms=doc.get("deadline_ms"),
+                                 temperature=temperature, top_k=top_k,
+                                 top_p=top_p, seed=seed,
+                                 request_id=self._request_id())
+            except QueueFullError as e:
+                self._respond(503, {"error": str(e)})
+                return
+            except DeadlineExceededError as e:
+                self._respond(429, {"error": str(e)})
+                return
+            except ValueError as e:  # could-never-fit, bad sampling params
+                self._respond(400, {"error": str(e)})
+                return
+            try:
+                tokens = gen.result(seq)
+            except DeadlineExceededError as e:
+                self._respond(429, {"error": str(e)})
+                return
+            except Exception as e:  # noqa: BLE001 — decode failure -> 500
+                log.warning("serving: generation failed for one sequence "
+                            "(request %s): %s", self._request_id(), e)
+                self._respond(500, {"error": str(e)})
+                return
+            self._respond(200, {"tokens": tokens,
+                                "logprobs": [round(x, 6)
+                                             for x in seq.logprobs],
+                                "step": gen.step})
 
 
 class InferenceServer:

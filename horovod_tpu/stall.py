@@ -121,10 +121,15 @@ class StallInspector:
                 if self._divergence_hint:
                     _schedule.note_divergence()
             hint = self._divergence_hint
+            # whose request was in flight: the ledger names the
+            # diverging call site, the tracer names the victim
+            from . import tracing as _tracing
+            rid = _tracing.last_request_id()
             raise StallError(
                 "horovod_tpu: collective stalled beyond "
                 "HVD_TPU_STALL_SHUTDOWN_TIME_SECONDS; shutting down."
-                + (f" {hint}" if hint else ""))
+                + (f" {hint}" if hint else "")
+                + (f" (request {rid} in flight)" if rid else ""))
 
     # -- background loop -----------------------------------------------------
     def _loop(self):
@@ -161,15 +166,19 @@ class StallInspector:
                 # pending past the warn deadline): a stale diagnosis
                 # must not contaminate a later, unrelated stall
                 self._divergence_hint = ""
+            if stalled:
+                from . import tracing as _tracing
+                rid = _tracing.last_request_id()
             for name in stalled:
                 _M_STALL_WARNINGS.inc()
                 log.warning(
                     "One or more collectives stalled for over %.0fs: %s. "
                     "This may indicate that a peer process is down or a "
                     "different subset of collectives was submitted on "
-                    "another process.%s", warn_after, name,
+                    "another process.%s%s", warn_after, name,
                     " " + self._divergence_hint
-                    if self._divergence_hint else "")
+                    if self._divergence_hint else "",
+                    f" (request {rid} in flight)" if rid else "")
 
     def _quiet(self) -> bool:
         """No collective is still flagged stalled (python-table path);

@@ -28,6 +28,11 @@ from ._native import get as _native_get
 _M_TL_EVENTS = _metrics.counter(
     "hvd_tpu_timeline_events_total",
     "Chrome-tracing events emitted by the timeline writer.")
+_M_TL_DROPPED = _metrics.counter(
+    "hvd_tpu_timeline_dropped_total",
+    "Records dropped because the bounded timeline/tracer writer queue "
+    "was full (HVD_TPU_TIMELINE_QUEUE_EVENTS) — the disk is slower "
+    "than the emit rate, or dead.")
 
 # Host-side activity names, mirroring the reference's
 # (/root/reference/horovod/common/common.h:31-59).
@@ -44,6 +49,75 @@ XLA_ALLTOALL = "XLA_ALLTOALL"
 NEGOTIATE = "NEGOTIATE"
 
 
+class RecordWriter:
+    """Bounded-queue background writer shared by the Timeline's Python
+    path and the request tracer (tracing.py). ``mode="chrome"`` streams
+    a chrome-tracing JSON array (comma-terminated records, tolerant of
+    a missing ``]`` on abnormal exit); ``mode="jsonl"`` writes one JSON
+    object per line. ``put`` never blocks: past the bound
+    (``HVD_TPU_TIMELINE_QUEUE_EVENTS``) records are dropped and counted
+    in ``hvd_tpu_timeline_dropped_total`` — a slow or dead disk must
+    cost trace completeness, never memory or the emitting thread."""
+
+    def __init__(self, path: str, mode: str = "chrome",
+                 maxsize: Optional[int] = None):
+        if maxsize is None:
+            maxsize = int(_config.live_config().get(
+                _config.TIMELINE_QUEUE_EVENTS))
+        self._path = path
+        self._mode = mode
+        self._q: "queue.Queue[Optional[dict]]" = queue.Queue(
+            maxsize=max(0, maxsize))
+        self._thread = threading.Thread(
+            target=self._drain, name="hvd_tpu_record_writer", daemon=True)
+        self._thread.start()
+
+    def put(self, record: dict) -> bool:
+        """Enqueue one record; False (and a drop count) when full."""
+        try:
+            self._q.put_nowait(record)
+            return True
+        except queue.Full:
+            _M_TL_DROPPED.inc()
+            return False
+
+    def _drain(self):
+        # Stream records to disk as they arrive (reference: timeline.cc
+        # writer thread appends continuously) so the trace survives
+        # abnormal exit — the primary use of a timeline is debugging
+        # jobs that hang or die. Chrome tracing's JSON-array format
+        # tolerates a missing ']', so a killed job still leaves a
+        # loadable trace; jsonl is line-framed and needs no closer.
+        chrome = self._mode == "chrome"
+        with open(self._path, "w") as f:
+            if chrome:
+                f.write("[\n")
+            n = 0
+            while True:
+                rec = self._q.get()
+                if rec is None:
+                    break
+                f.write(json.dumps(rec))
+                f.write(",\n" if chrome else "\n")
+                n += 1
+                if n % 50 == 0 or self._q.empty():
+                    f.flush()
+            if chrome:
+                f.write("{}]\n")
+
+    def close(self, timeout: float = 10.0) -> bool:
+        """Stop the writer; True when it drained and exited in time.
+        The close sentinel waits for queue room (a full queue must not
+        lose the shutdown), bounded by the same timeout."""
+        deadline = time.monotonic() + timeout
+        try:
+            self._q.put(None, timeout=timeout)
+        except queue.Full:
+            return False
+        self._thread.join(timeout=max(0.0, deadline - time.monotonic()))
+        return not self._thread.is_alive()
+
+
 class Timeline:
     """Thread-safe chrome-tracing writer. All public methods are cheap when
     disabled (no-op guard on first line).
@@ -58,7 +132,6 @@ class Timeline:
     def __init__(self, path: str, mark_cycles: bool = False):
         self._path = path
         self._mark_cycles = mark_cycles
-        self._q: "queue.Queue[Optional[dict]]" = queue.Queue()
         self._tids = {}
         self._next_tid = 1
         self._lock = threading.Lock()
@@ -71,12 +144,10 @@ class Timeline:
         self._native_lock = threading.Lock()
         if self._nat is not None:
             self._h = self._nat.cdll.hvd_tl_create(path.encode())
-        self._thread = None
+        self._w = None
         if self._h is None:
             self._nat = None
-            self._thread = threading.Thread(
-                target=self._writer, name="hvd_tpu_timeline", daemon=True)
-            self._thread.start()
+            self._w = RecordWriter(path, mode="chrome")
 
     @property
     def enabled(self) -> bool:
@@ -98,7 +169,7 @@ class Timeline:
                 tid = self._next_tid
                 self._next_tid += 1
                 self._tids[tensor_name] = tid
-                self._q.put({"name": "thread_name", "ph": "M", "pid": 0,
+                self._w.put({"name": "thread_name", "ph": "M", "pid": 0,
                              "tid": tid, "args": {"name": tensor_name}})
             return tid
 
@@ -119,7 +190,7 @@ class Timeline:
               "ts": self._now_us()}
         if args:
             ev["args"] = args
-        self._q.put(ev)
+        self._w.put(ev)
 
     # -- per-tensor lifecycle (reference: timeline.h:77-99) ------------------
     def negotiate_start(self, tensor_name: str, op_name: str):
@@ -150,7 +221,7 @@ class Timeline:
                     return
                 self._nat.cdll.hvd_tl_emit(self._h, b"", b"E", tid, None)
             return
-        self._q.put({"name": "", "ph": "E", "pid": 0,
+        self._w.put({"name": "", "ph": "E", "pid": 0,
                      "tid": self._tid(tensor_name), "ts": self._now_us()})
 
     def end(self, tensor_name: str):
@@ -166,7 +237,7 @@ class Timeline:
                     self._nat.cdll.hvd_tl_emit(
                         self._h, b"CYCLE", b"i", 0, None)
                 return
-            self._q.put({"name": "CYCLE", "ph": "i", "pid": 0, "tid": 0,
+            self._w.put({"name": "CYCLE", "ph": "i", "pid": 0, "tid": 0,
                          "ts": self._now_us(), "s": "g"})
 
     # -- device-side: splice in the XLA profiler -----------------------------
@@ -187,27 +258,6 @@ class Timeline:
         import jax
         jax.profiler.stop_trace()
 
-    # -- writer --------------------------------------------------------------
-    def _writer(self):
-        # Stream events to disk as they arrive (reference: timeline.cc writer
-        # thread appends continuously) so the trace survives abnormal exit —
-        # the primary use of a timeline is debugging jobs that hang or die.
-        # Chrome tracing's JSON-array format tolerates a missing ']', so a
-        # killed job still leaves a loadable trace.
-        with open(self._path, "w") as f:
-            f.write("[\n")
-            n = 0
-            while True:
-                ev = self._q.get()
-                if ev is None:
-                    break
-                f.write(json.dumps(ev))
-                f.write(",\n")
-                n += 1
-                if n % 50 == 0 or self._q.empty():
-                    f.flush()
-            f.write("{}]\n")
-
     def close(self):
         if self._closed:
             return
@@ -218,9 +268,7 @@ class Timeline:
                 h, self._h = self._h, None
             self._nat.cdll.hvd_tl_close(h)
         else:
-            self._q.put(None)
-            self._thread.join(timeout=10)
-            writer_done = not self._thread.is_alive()
+            writer_done = self._w.close(timeout=10)
         if not writer_done:
             # a wedged/backlogged writer still owns the file handle;
             # splicing would interleave two writers into an unparseable

@@ -1,7 +1,7 @@
 #!/usr/bin/env python
 """Collective-plane microbenchmark driver (VERDICT r3 item 2).
 
-Runs six sections, each in killable CPU subprocesses, and writes
+Runs seven sections, each in killable CPU subprocesses, and writes
 ``MICROBENCH.json``:
 
 1. ``eager_1proc``  — payload sweep of the eager plane with one process:
@@ -41,10 +41,16 @@ Runs six sections, each in killable CPU subprocesses, and writes
    fingerprint fold amortized at ``fingerprint_every=20``; the
    guard-on/off step-time delta is the cost of ``HVD_TPU_SDC_GUARD``
    (target <2% where the guard's reductions fuse into the update pass).
+7. ``tracing``      — per-request distributed-tracer overhead
+   (docs/timeline.md) on the serving hot path's instrumentation
+   sequence (root request span, nested span, retroactive span,
+   collective hook), ``HVD_TPU_TRACE_SAMPLE=0`` vs ``=1``: the off
+   delta over a bare loop is the zero-overhead-when-disabled
+   acceptance number.
 
 Usage: ``python microbench.py [--quick]``. Workers are internal
 (``--worker-eager`` / ``--worker-scaling`` / ``--worker-injit`` /
-``--worker-generation`` / ``--worker-sdc``).
+``--worker-generation`` / ``--worker-sdc`` / ``--worker-tracing``).
 """
 
 import json
@@ -245,6 +251,32 @@ def _run_sdc(quick: bool, timeout: int):
     return rows[0] if rows else None
 
 
+def worker_tracing(quick: bool) -> int:
+    from horovod_tpu.microbench import tracing_overhead_sweep
+    row = tracing_overhead_sweep(requests=5000 if quick else 20000,
+                                 rounds=2 if quick else 3)
+    print(MB_TAG + json.dumps(row))
+    return 0
+
+
+def _run_tracing(quick: bool, timeout: int):
+    cmd = [sys.executable, os.path.abspath(__file__), "--worker-tracing"]
+    if quick:
+        cmd.append("--quick")
+    try:
+        p = subprocess.run(cmd, env=_cpu_env(), text=True,
+                           capture_output=True, timeout=timeout)
+    except subprocess.TimeoutExpired:
+        _log("tracing: timeout")
+        return None
+    sys.stderr.write(p.stderr or "")
+    if p.returncode != 0:
+        _log(f"tracing: rc={p.returncode}")
+        return None
+    rows = _collect(p.stdout or "")
+    return rows[0] if rows else None
+
+
 def _run_injit(n: int, quick: bool, timeout: int):
     env = _cpu_env({
         "XLA_FLAGS": f"--xla_force_host_platform_device_count={n}",
@@ -281,6 +313,8 @@ def main():
             return worker_generation(quick)
         if a == "--worker-sdc":
             return worker_sdc(quick)
+        if a == "--worker-tracing":
+            return worker_tracing(quick)
 
     t0 = time.time()
     result = {"quick": quick}
@@ -292,15 +326,15 @@ def main():
         bk = next((r for r in rows if "scenario" in r), None)
         return plain, bk
 
-    _log("section 1/6: eager sweep, 1 process")
+    _log("section 1/7: eager sweep, 1 process")
     result["eager_1proc"], result["bucketed_1proc"] = split_bucketed(
         _run_eager(1, quick, timeout=600))
 
-    _log("section 2/6: eager sweep, 2 processes")
+    _log("section 2/7: eager sweep, 2 processes")
     result["eager_2proc"], result["bucketed_2proc"] = split_bucketed(
         _run_eager(2, quick, timeout=900))
 
-    _log("section 3/6: compiled-plane scaling sweep")
+    _log("section 3/7: compiled-plane scaling sweep")
     points = []
     for n in (1, 2, 4, 8):
         row = _run_scaling(n, quick, timeout=600)
@@ -315,7 +349,7 @@ def main():
                 / (p["num_devices"] * base["images_per_sec_total"]), 3)
     result["scaling"] = points
 
-    _log("section 4/6: in-jit fast path (ResNet-50 gradient scenario)")
+    _log("section 4/7: in-jit fast path (ResNet-50 gradient scenario)")
     injit_rows = []
     for n in ((1, 2) if quick else (1, 2, 8)):
         row = _run_injit(n, quick, timeout=900)
@@ -337,7 +371,7 @@ def main():
                  f"(x{row['packed_speedup_vs_per_leaf']} vs per-leaf)")
     result["injit"] = injit_rows
 
-    _log("section 5/6: continuous vs static batch generation + sampling")
+    _log("section 5/7: continuous vs static batch generation + sampling")
     gen_rows = _run_generation(quick, timeout=1200)
     gen = gen_rows[0] if gen_rows else None
     sampling = gen_rows[1] if gen_rows and len(gen_rows) > 1 else None
@@ -363,7 +397,7 @@ def main():
     result["generation_sampling"] = sampling
     result["generation_prefix"] = prefix
 
-    _log("section 6/6: SDC guard + fingerprint overhead")
+    _log("section 6/7: SDC guard + fingerprint overhead")
     sdc = _run_sdc(quick, timeout=600)
     if sdc:
         _log(f"  guard on/off: {sdc['guarded_ms_per_step']} vs "
@@ -373,6 +407,16 @@ def main():
              f"{sdc['fingerprint_fold_ms']} ms every "
              f"{sdc['fingerprint_every']} steps")
     result["sdc"] = sdc
+
+    _log("section 7/7: per-request tracing overhead")
+    tracing_row = _run_tracing(quick, timeout=300)
+    if tracing_row:
+        _log(f"  off {tracing_row['off_us_per_req']} us/req over bare "
+             f"{tracing_row['bare_us_per_req']} "
+             f"(+{tracing_row['off_overhead_us_per_req']} us disabled), "
+             f"on {tracing_row['on_us_per_req']} us/req "
+             f"(+{tracing_row['on_overhead_us_per_req']} us traced)")
+    result["tracing"] = tracing_row
     result["wall_s"] = round(time.time() - t0, 1)
 
     out_path = os.path.join(ROOT, "MICROBENCH.json")
@@ -415,6 +459,10 @@ def main():
         "sdc_guard_overhead_pct": sdc["overhead_pct"] if sdc else None,
         "sdc_fingerprint_fold_ms": sdc["fingerprint_fold_ms"]
         if sdc else None,
+        "tracing_off_overhead_us_per_req": tracing_row
+        ["off_overhead_us_per_req"] if tracing_row else None,
+        "tracing_on_overhead_us_per_req": tracing_row
+        ["on_overhead_us_per_req"] if tracing_row else None,
     }))
     return 0
 

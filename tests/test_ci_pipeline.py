@@ -225,6 +225,24 @@ def test_chaos_sdc_suite_is_seeded_and_exclusive():
     assert os.path.exists(os.path.join(root, "tests", "test_sdc.py"))
 
 
+def test_observability_suite_is_seeded_and_exclusive():
+    """The per-request tracing suite (span propagation units, the
+    zero-overhead contract, the tools.trace merger, the seeded 2-proc
+    router->replica->collective drill) runs as its own seeded CI suite;
+    the generic unit and chaos suites must not run the same file
+    twice."""
+    by_name = {name: cmd for name, cmd, _t in COMMON_SUITES}
+    assert "observability" in by_name
+    cmd = by_name["observability"]
+    assert "HVD_TPU_FAULT_SEED=" in cmd
+    assert "tests/test_tracing.py" in cmd
+    assert "--ignore=tests/test_tracing.py" in by_name["unit"]
+    assert "--ignore=tests/test_tracing.py" in by_name["chaos"]
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    assert os.path.exists(os.path.join(root, "tests", "test_tracing.py"))
+    assert os.path.exists(os.path.join(root, "tools", "trace.py"))
+
+
 def test_lint_static_suite_in_every_service():
     """The unified static-analysis suite (tools/analyze: lock-discipline,
     lock-order, contract lints, jit-purity, knobs, plus the

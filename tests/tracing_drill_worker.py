@@ -1,0 +1,126 @@
+"""Worker for the seeded cross-host tracing drill.
+
+Rank 0 runs the real serving stack — a :class:`FleetRouter` fronting an
+:class:`InferenceServer` over a :class:`GenerationEngine` — and POSTs
+one ``/v1/generate`` request through the router under a fixed request
+id (``TRACING_DRILL_TRACE_ID``), producing the router / admission /
+server / prefill / decode spans on the real request path. It then hands
+the trace context to rank 1 through the rendezvous KV store and both
+ranks submit the same eager allreduce under it, so BOTH ranks emit a
+``collective:allreduce:drill_grad`` span for the same trace. Each rank
+flushes its span file (``HVD_TPU_TRACE_DIR``) and publishes its ring to
+the KV ``trace`` scope; the parent test merges both sources with
+``tools.trace`` and asserts one ordered cross-host timeline.
+"""
+
+import json
+import os
+import sys
+import time
+from urllib.request import Request, urlopen
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ.pop("XLA_FLAGS", None)
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+import horovod_tpu as hvd  # noqa: E402
+from horovod_tpu import tracing  # noqa: E402
+
+TRACE_ID = os.environ["TRACING_DRILL_TRACE_ID"]
+PROMPT = [1, 2, 3, 4, 5, 6]       # 6 tokens / prefill_chunk=4 -> 2 chunks
+
+
+def _serve_one_request():
+    """The real request path on rank 0: router -> replica -> engine."""
+    import jax.numpy as jnp
+
+    from horovod_tpu import serving
+    from horovod_tpu.models.transformer import (Transformer,
+                                                TransformerConfig)
+    from horovod_tpu.serving import fleet
+    from horovod_tpu.serving.generation import GenerationEngine
+
+    cfg = TransformerConfig(vocab_size=64, num_layers=1, d_model=16,
+                            num_heads=2, head_dim=8, max_seq_len=32,
+                            dtype=jnp.float32)
+    model = Transformer(cfg)
+    params = model.init(jax.random.PRNGKey(0),
+                        jnp.zeros((1, 4), jnp.int32))
+    engine = GenerationEngine(model, params=params, block_size=4,
+                              num_blocks=17, max_seqs=2, prefill_chunk=4,
+                              deadline_ms=0, reload_poll_seconds=0)
+    srv = serving.InferenceServer(None, gen_engine=engine, port=0,
+                                  addr="127.0.0.1")
+    srv.start()
+    router = fleet.FleetRouter({"r0": f"http://127.0.0.1:{srv.port}"},
+                               port=0, addr="127.0.0.1")
+    router.start()
+    try:
+        body = json.dumps({"prompt": PROMPT, "max_tokens": 3}).encode()
+        req = Request(router.url + "/v1/generate", data=body,
+                      method="POST",
+                      headers={"Content-Type": "application/json",
+                               "X-HVD-TPU-Request-Id": TRACE_ID})
+        with urlopen(req, timeout=180) as resp:
+            doc = json.loads(resp.read())
+            echoed = resp.headers.get("X-HVD-TPU-Request-Id")
+        assert echoed == TRACE_ID, echoed
+        assert len(doc["tokens"]) == 3, doc
+    finally:
+        router.stop()
+        srv.stop()
+        engine.close()
+
+
+def main() -> int:
+    hvd.init()
+    rank = hvd.rank()
+    tr = tracing.tracer()
+    assert tr is not None, "drill needs HVD_TPU_TRACE_SAMPLE=1"
+    kv = tr._kv_client()
+    assert kv is not None, "drill needs the rendezvous KV knobs"
+
+    # warm the eager collective path OUTSIDE any trace context: this
+    # submission must not produce a span
+    hvd.allreduce(np.ones(3, np.float32), name="warm")
+
+    if rank == 0:
+        _serve_one_request()
+        # the cross-host hop: hand our span context to rank 1, then
+        # submit the collective under it — rank 1 enters the same
+        # allreduce only after adopting the context, so both ranks'
+        # collective spans share the trace
+        with tracing.request_span("drill.step", TRACE_ID) as sp:
+            kv.put(tracing.KV_SCOPE, "drill-ctx",
+                   sp.context().encode().encode())
+            hvd.allreduce(np.ones(4, np.float32), name="drill_grad")
+    else:
+        raw = None
+        deadline = time.monotonic() + 300
+        while time.monotonic() < deadline:
+            raw = kv.get(tracing.KV_SCOPE, "drill-ctx")
+            if raw:
+                break
+            time.sleep(0.1)
+        assert raw, "rank 0 never published the drill trace context"
+        ctx = tracing.TraceContext.decode(raw.decode())
+        assert ctx is not None and ctx.trace_id == TRACE_ID, raw
+        with tracing.span_for(ctx, "drill.step"):
+            hvd.allreduce(np.ones(4, np.float32), name="drill_grad")
+
+    n_mine = len(tr.spans(TRACE_ID))
+    published = tr.publish()
+    tracing.reset()        # closes the writer: the span file is complete
+    print(f"rank {rank}: NSPANS {n_mine} PUBLISHED {int(published)}",
+          flush=True)
+    hvd.shutdown()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,173 @@
+"""Cross-host trace merger: one request's spans from every rank into a
+single chrome://tracing timeline.
+
+Each traced process collects spans keyed by the serving request id
+(``horovod_tpu.tracing``). This tool assembles the cross-host view for
+one request from either source the tracer exports:
+
+* **span files** — the per-rank ``spans-rank<N>.jsonl`` files written
+  under ``HVD_TPU_TRACE_DIR``::
+
+      python -m tools.trace --trace-id a1b2c3 /traces/spans-rank*.jsonl \
+          -o request.json
+
+* **the rendezvous KV store** — a live fleet whose ranks called
+  ``Tracer.publish()`` (scope ``trace``, key ``rank<N>``)::
+
+      python -m tools.trace --trace-id a1b2c3 --kv 10.0.0.1:7399
+
+The output is a chrome-tracing JSON object (``chrome://tracing`` /
+Perfetto): one complete ``X`` event per span, ``pid`` = owning rank
+(labeled by process_name metadata), sorted by start time. Span start
+timestamps are epoch microseconds stamped by each host's wall clock, so
+cross-host ordering is as honest as the fleet's clock sync — fine for
+"where did the time go", not for ns-level causality.
+
+The module is importable: :func:`merge` is the pure core the drill test
+and this CLI share.
+"""
+
+import argparse
+import json
+import sys
+from typing import Dict, Iterable, List, Optional
+
+
+def load_span_file(path: str) -> List[dict]:
+    """Spans from one per-rank jsonl file (one object per line; blank
+    and truncated trailing lines are skipped — the writer may have been
+    killed mid-record)."""
+    out: List[dict] = []
+    with open(path, "r", encoding="utf-8") as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue
+            if isinstance(rec, dict) and "trace" in rec:
+                out.append(rec)
+    return out
+
+
+def fetch_kv_spans(addr: str, port: int, max_ranks: int = 1024) -> List[dict]:
+    """Spans published by a live fleet to the rendezvous ``trace``
+    scope: probes ``rank0``, ``rank1``, ... until the first absent key
+    (ranks publish densely)."""
+    from horovod_tpu import retry as _retry
+    from horovod_tpu.runner.rendezvous import KVStoreClient
+    from horovod_tpu.tracing import KV_SCOPE
+    client = KVStoreClient(
+        addr, int(port), timeout=5.0,
+        retry=_retry.RetryPolicy(max_attempts=1, initial_backoff=0.05,
+                                 max_backoff=0.1, deadline=5.0))
+    out: List[dict] = []
+    for rank in range(max_ranks):
+        raw = client.get(KV_SCOPE, f"rank{rank}")
+        if raw is None:
+            break
+        try:
+            spans = json.loads(raw.decode("utf-8"))
+        except ValueError:
+            continue
+        out.extend(s for s in spans if isinstance(s, dict) and "trace" in s)
+    return out
+
+
+def merge(trace_id: str, spans: Iterable[dict]) -> dict:
+    """One request's spans -> a chrome-tracing document.
+
+    ``spans`` is any iterable of tracer span dicts (mixed ranks, any
+    order, duplicates tolerated — a span re-published to the KV scope
+    after also landing in a file dedupes on its span id). Returns the
+    ``{"traceEvents": [...]}`` document with events sorted by start
+    timestamp; ``pid`` is the owning rank so each rank renders as its
+    own process lane.
+    """
+    seen: set = set()
+    picked: List[dict] = []
+    for s in spans:
+        if s.get("trace") != trace_id:
+            continue
+        key = s.get("span") or id(s)
+        if key in seen:
+            continue
+        seen.add(key)
+        picked.append(s)
+    picked.sort(key=lambda s: (s.get("ts", 0.0), s.get("rank", 0)))
+    events: List[dict] = []
+    ranks: Dict[int, bool] = {}
+    for s in picked:
+        rank = int(s.get("rank", 0))
+        ranks[rank] = True
+        args = dict(s.get("args") or {})
+        args["span_id"] = s.get("span")
+        if s.get("parent"):
+            args["parent_id"] = s["parent"]
+        events.append({"name": s["name"], "ph": "X",
+                       "ts": float(s.get("ts", 0.0)),
+                       "dur": float(s.get("dur", 0.0)),
+                       "pid": rank, "tid": 0, "args": args})
+    meta = [{"name": "process_name", "ph": "M", "pid": rank, "tid": 0,
+             "args": {"name": f"rank {rank}"}} for rank in sorted(ranks)]
+    return {"traceEvents": meta + events,
+            "displayTimeUnit": "ms",
+            "otherData": {"trace_id": trace_id,
+                          "spans": len(events),
+                          "ranks": sorted(ranks)}}
+
+
+def span_names(doc: dict) -> List[str]:
+    """The merged document's span names in start-time order (metadata
+    events excluded) — what the drill asserts on."""
+    return [e["name"] for e in doc["traceEvents"] if e.get("ph") == "X"]
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m tools.trace",
+        description="Merge one request's spans from every rank into a "
+                    "chrome://tracing timeline.")
+    parser.add_argument("--trace-id", required=True,
+                        help="the request id to assemble (the "
+                             "X-HVD-TPU-Request-Id value)")
+    parser.add_argument("files", nargs="*",
+                        help="per-rank spans-rank<N>.jsonl files "
+                             "(HVD_TPU_TRACE_DIR)")
+    parser.add_argument("--kv", metavar="ADDR:PORT",
+                        help="also read spans published to this "
+                             "rendezvous KV store's 'trace' scope")
+    parser.add_argument("-o", "--output", default="-",
+                        help="output path (default: stdout)")
+    args = parser.parse_args(argv)
+    if not args.files and not args.kv:
+        parser.error("need span files and/or --kv")
+    spans: List[dict] = []
+    for path in args.files:
+        spans.extend(load_span_file(path))
+    if args.kv:
+        addr, _, port = args.kv.rpartition(":")
+        if not addr or not port.isdigit():
+            parser.error(f"--kv {args.kv!r}: want ADDR:PORT")
+        spans.extend(fetch_kv_spans(addr, int(port)))
+    doc = merge(args.trace_id, spans)
+    n = doc["otherData"]["spans"]
+    if n == 0:
+        print(f"trace {args.trace_id}: no spans found", file=sys.stderr)
+        return 1
+    payload = json.dumps(doc, indent=1)
+    if args.output == "-":
+        print(payload)
+    else:
+        with open(args.output, "w", encoding="utf-8") as f:
+            f.write(payload + "\n")
+        print(f"trace {args.trace_id}: {n} span(s) across "
+              f"{len(doc['otherData']['ranks'])} rank(s) -> {args.output}",
+              file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
