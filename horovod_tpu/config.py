@@ -687,6 +687,41 @@ FLEET_TENANT_WEIGHT = _register(
          "scheduling: a weight-2 tenant dequeues twice as often as a "
          "weight-1 tenant under contention, within a priority class). "
          "Priority classes strictly outrank weights.")
+FLEET_DEFAULT_DEADLINE_MS = _register(
+    "FLEET_DEFAULT_DEADLINE_MS", 0.0, float,
+    help="End-to-end latency budget (ms) the fleet router mints for "
+         "requests that arrive without an X-HVD-TPU-Deadline-Ms header. "
+         "The budget is decremented at every hop (route -> fair-queue "
+         "wait -> prefill admission -> per-token decode) and an "
+         "un-meetable request is shed with HTTP 429 plus an "
+         "X-HVD-TPU-Deadline-Exceeded header naming the stage that "
+         "noticed. 0 (default) falls back to HVD_TPU_SERVING_DEADLINE_"
+         "MS for the router's queue wait (legacy behavior).")
+FLEET_HEDGE_QUANTILE = _register(
+    "FLEET_HEDGE_QUANTILE", 0.0, float,
+    help="Latency quantile (0..1) of the router's observed non-"
+         "streaming proxy latency after which a still-pending request "
+         "is hedged to a second replica: first response wins, the "
+         "loser is cancelled via POST /v1/cancel. Hedges spend from "
+         "the per-tenant retry budget (HVD_TPU_FLEET_RETRY_BUDGET_"
+         "RATIO). 0 (default) disables hedging; the trigger arms only "
+         "once enough latency samples exist to estimate the quantile.")
+FLEET_RETRY_BUDGET_RATIO = _register(
+    "FLEET_RETRY_BUDGET_RATIO", 0.1, float,
+    help="Per-tenant token-bucket retry budget: every primary request "
+         "a tenant sends earns this many retry tokens (capped at "
+         "HVD_TPU_FLEET_RETRY_BUDGET_BURST) and every retry, hedge, or "
+         "mid-stream failover the router issues on the tenant's behalf "
+         "spends one. An exhausted budget degrades the router to "
+         "pass-through — failures are relayed instead of amplified "
+         "into a retry storm.")
+FLEET_RETRY_BUDGET_BURST = _register(
+    "FLEET_RETRY_BUDGET_BURST", 16, int,
+    help="Cap (and initial fill) of the per-tenant retry-budget token "
+         "bucket, in retries. Bounds how many retries/hedges/failovers "
+         "the router can issue for one tenant in a burst before the "
+         "HVD_TPU_FLEET_RETRY_BUDGET_RATIO accrual becomes the "
+         "limiting rate.")
 
 # -- Misc -------------------------------------------------------------------
 NUM_STREAMS = _register(

@@ -977,3 +977,193 @@ def tracing_overhead_sweep(requests: int = 20000, rounds: int = 3) -> dict:
         "on_overhead_us_per_req": round(on_us - off_us, 4),
         "on_over_off": round(on_us / off_us, 2) if off_us > 0 else None,
     }
+
+
+def hedging_sweep(requests: int = 80, slow_every: int = 10,
+                  slow_ms: float = 250.0, fast_ms: float = 4.0,
+                  hedge_quantile: float = 0.8) -> dict:
+    """Tail latency of the fleet router's hedged retries
+    (docs/robustness.md request survivability) under a workload where
+    1-in-``slow_every`` requests stalls on its replica for ``slow_ms``
+    — the canonical straggler shape hedging exists for. The replicas
+    are latency-scripted HTTP stubs (no model): the quantity under
+    test is the ROUTER's hedge race, not a forward pass. Reports
+    p50/p99 with hedging off and on; the p99 ratio is the acceptance
+    number — the slow tail collapses to roughly the hedge delay.
+
+    The quantile sits BELOW the slow fraction (0.8 < 0.9): the router
+    indexes its sorted latency window at ``int(q * n)``, so with
+    exactly 10% slow a 0.9 quantile lands on the first slow sample and
+    the hedge delay degenerates to the straggler latency itself. The
+    retry budget is pinned wide open for the run — the budget's
+    collapse-to-pass-through behaviour is a correctness property
+    (tests/test_failover.py), not the tail effect measured here."""
+    import json as _json
+    import os
+    import threading
+    import urllib.request
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    from .serving import fleet
+
+    def make_stub():
+        class _Stub(BaseHTTPRequestHandler):
+            count = 0
+            lock = threading.Lock()
+
+            def do_GET(self):  # healthz for circuit probes
+                self._answer(b'{"ok": true}')
+
+            def do_POST(self):
+                length = int(self.headers.get("Content-Length", 0))
+                self.rfile.read(length)
+                if self.path != "/v1/cancel":
+                    with type(self).lock:
+                        type(self).count += 1
+                        n = type(self).count
+                    if n % slow_every == 0:
+                        time.sleep(slow_ms / 1e3)
+                    else:
+                        time.sleep(fast_ms / 1e3)
+                self._answer(b'{"outputs": []}')
+
+            def _answer(self, body):
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *a):
+                pass
+
+        srv = ThreadingHTTPServer(("127.0.0.1", 0), _Stub)
+        threading.Thread(target=srv.serve_forever, daemon=True).start()
+        return srv
+
+    knobs = {"HVD_TPU_FLEET_HEDGE_QUANTILE": None,
+             "HVD_TPU_FLEET_RETRY_BUDGET_RATIO": "1.0",
+             "HVD_TPU_FLEET_RETRY_BUDGET_BURST": "64"}
+
+    def measure(quantile):
+        knobs["HVD_TPU_FLEET_HEDGE_QUANTILE"] = str(quantile)
+        prior = {k: os.environ.get(k) for k in knobs}
+        os.environ.update(knobs)
+        stubs = [make_stub(), make_stub()]
+        try:
+            router = fleet.FleetRouter(
+                {f"r{i}": f"http://127.0.0.1:{s.server_address[1]}"
+                 for i, s in enumerate(stubs)},
+                port=0, addr="127.0.0.1")
+            router.start()
+            lat = []
+            body = _json.dumps({"inputs": [[0.0]]}).encode()
+            for _ in range(requests):
+                req = urllib.request.Request(
+                    router.url + "/v1/infer", data=body, method="POST",
+                    headers={"Content-Type": "application/json"})
+                t0 = time.perf_counter()
+                with urllib.request.urlopen(req, timeout=30) as resp:
+                    resp.read()
+                lat.append((time.perf_counter() - t0) * 1e3)
+            router.stop()
+            return lat
+        finally:
+            for k, v in prior.items():
+                if v is None:
+                    os.environ.pop(k, None)
+                else:
+                    os.environ[k] = v
+            for s in stubs:
+                s.shutdown()
+                s.server_close()
+
+    from . import metrics as _metrics
+    off = measure(0.0)
+    before = _metrics.snapshot()
+    on = measure(hedge_quantile)
+    snap = _metrics.snapshot()
+
+    def pct(xs, q):
+        return round(float(np.percentile(np.asarray(xs), q)), 2)
+
+    launched = snap.get('hvd_tpu_fleet_hedges_total{outcome="launched"}',
+                        0) - before.get(
+        'hvd_tpu_fleet_hedges_total{outcome="launched"}', 0)
+    won = snap.get('hvd_tpu_fleet_hedges_total{outcome="won"}',
+                   0) - before.get(
+        'hvd_tpu_fleet_hedges_total{outcome="won"}', 0)
+    return {
+        "scenario": "fleet_hedging_tail",
+        "requests": requests,
+        "slow_every": slow_every,
+        "slow_ms": slow_ms,
+        "fast_ms": fast_ms,
+        "hedge_quantile": hedge_quantile,
+        "off": {"p50_ms": pct(off, 50), "p99_ms": pct(off, 99)},
+        "on": {"p50_ms": pct(on, 50), "p99_ms": pct(on, 99),
+               "hedges_launched": int(launched), "hedges_won": int(won)},
+        "p99_speedup": round(pct(off, 99) / max(pct(on, 99), 1e-9), 2),
+    }
+
+
+def resume_sweep(emitted: int = 256, prompt_len: int = 8,
+                 block_size: int = 8) -> dict:
+    """Cost of a mid-stream failover resume — re-submitting
+    ``prompt + emitted`` with the journaled seed and ``sample_offset``
+    — at ``emitted`` already-delivered tokens, with the automatic
+    prefix cache on vs off (docs/inference.md). With the cache on, the
+    original generation's blocks are still resident, so the resume's
+    re-prefill is mostly block reuse; off, it recomputes every chunk.
+    The time to the resumed FIRST token is what a live client observes
+    as the failover gap."""
+    import jax
+    import jax.numpy as jnp
+
+    from .models.transformer import Transformer, TransformerConfig
+    from .serving.generation import GenerationEngine
+
+    cfg = TransformerConfig(vocab_size=512, num_layers=4, d_model=128,
+                            num_heads=4, head_dim=32,
+                            max_seq_len=prompt_len + emitted + 8,
+                            dtype=jnp.float32)
+    model = Transformer(cfg)
+    params = model.init(jax.random.PRNGKey(0),
+                        jnp.zeros((1, 4), jnp.int32))
+    rng = np.random.RandomState(0)
+    prompt = rng.randint(0, cfg.vocab_size, (prompt_len,)).tolist()
+    num_blocks = 2 * ((prompt_len + emitted + 8) // block_size + 2)
+    sampling = dict(temperature=0.9, top_k=40, top_p=0.95, seed=7)
+
+    def run(prefix_cache):
+        with GenerationEngine(model, params=params,
+                              block_size=block_size,
+                              num_blocks=num_blocks, max_seqs=2,
+                              prefill_chunk=32, deadline_ms=0,
+                              prefix_cache=prefix_cache) as eng:
+            head = eng.result(
+                eng.submit(prompt, max_tokens=emitted, **sampling),
+                timeout=1200)
+            # the failover moment: re-submit prompt+emitted elsewhere
+            t0 = time.perf_counter()
+            tail = eng.result(
+                eng.submit(prompt + head, max_tokens=1,
+                           sample_offset=emitted, **sampling),
+                timeout=1200)
+            first_token_ms = (time.perf_counter() - t0) * 1e3
+            return head, tail, round(first_token_ms, 2)
+
+    head_on, tail_on, ms_on = run(True)
+    head_off, tail_off, ms_off = run(False)
+    return {
+        "scenario": "stream_resume_cost",
+        "emitted_tokens": emitted,
+        "prompt_len": prompt_len,
+        # same seed + sample_offset: both engines must continue the
+        # same sampled stream (the bit-identity the failover relies on)
+        "bit_identical": bool(head_on == head_off
+                              and tail_on == tail_off),
+        "resume_first_token_ms_cache_on": ms_on,
+        "resume_first_token_ms_cache_off": ms_off,
+        "cached_resume_speedup": round(ms_off / max(ms_on, 1e-9), 2),
+    }

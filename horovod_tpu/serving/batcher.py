@@ -69,6 +69,24 @@ _M_REJECTED = _metrics.counter(
     "(bounded queue at capacity, HTTP 503) or 'deadline' (per-request "
     "deadline expired before dispatch, HTTP 429).",
     labels=("reason",))
+_M_DEADLINE_STAGE = _metrics.counter(
+    "hvd_tpu_serving_deadline_stage_total",
+    "Requests shed because their end-to-end budget (X-HVD-TPU-Deadline-"
+    "Ms) died, by the pipeline stage that noticed: 'route' (router "
+    "proxy, budget gone before any replica was touched), 'queue' "
+    "(fair-queue / micro-batch / prefill-admission wait), 'prefill' "
+    "(mid-prefill, before the next chunk ran), 'decode' (between "
+    "generated tokens). The same stage is returned to the client in "
+    "the X-HVD-TPU-Deadline-Exceeded response header.",
+    labels=("stage",))
+
+#: end-to-end budget header: remaining milliseconds, minted at the
+#: fleet router and re-stamped (decremented) on every forwarded hop
+DEADLINE_HEADER = "X-HVD-TPU-Deadline-Ms"
+#: stamped on 429 responses: the pipeline stage where the budget died
+#: (route | queue | prefill | decode)
+DEADLINE_STAGE_HEADER = "X-HVD-TPU-Deadline-Exceeded"
+
 
 class RejectedError(RuntimeError):
     """Base for admission-control rejections (fast backpressure, not
@@ -81,8 +99,18 @@ class QueueFullError(RejectedError):
 
 
 class DeadlineExceededError(RejectedError):
-    """The request's deadline expired before its micro-batch dispatched
-    (HTTP 429 at the front-end)."""
+    """The request's deadline expired (HTTP 429 at the front-end).
+    ``stage`` names the pipeline stage that noticed the dead budget
+    (route | queue | prefill | decode) for the
+    X-HVD-TPU-Deadline-Exceeded response header; shedding sites that
+    know their stage count it in
+    ``hvd_tpu_serving_deadline_stage_total``."""
+
+    def __init__(self, message: str, stage: Optional[str] = None):
+        super().__init__(message)
+        self.stage = stage
+        if stage is not None:
+            _M_DEADLINE_STAGE.labels(stage=stage).inc()
 
 
 #: an injected ``serving.admit`` error looks like what it simulates —
@@ -287,7 +315,7 @@ class MicroBatcher:
             _M_REJECTED.labels(reason="deadline").inc()
             raise DeadlineExceededError(
                 f"request deadline_ms={deadline_ms} is negative: "
-                f"budget already spent before admission")
+                f"budget already spent before admission", stage="queue")
         deadline = time.monotonic() + ddl_s if ddl_s > 0 else float("inf")
         req = _Request(x, deadline)
         self._ensure_thread()
@@ -402,7 +430,8 @@ class MicroBatcher:
             return False
         _M_REJECTED.labels(reason="deadline").inc()
         req.error = DeadlineExceededError(
-            f"deadline expired {now - req.deadline:.3f}s before dispatch")
+            f"deadline expired {now - req.deadline:.3f}s before dispatch",
+            stage="queue")
         req.event.set()
         return True
 

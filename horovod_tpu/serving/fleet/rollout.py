@@ -12,7 +12,10 @@ plane. :func:`rolling_reload` makes the swap boring:
    requests (``hvd_tpu_fleet_outstanding{replica}`` is the evidence);
 2. wait for its outstanding count to reach **0** (in-flight requests
    complete normally), bounded by
-   ``HVD_TPU_FLEET_DRAIN_DEADLINE_SECONDS``;
+   ``HVD_TPU_FLEET_DRAIN_DEADLINE_SECONDS`` — extended, for a replica
+   holding long-lived generation streams, to the streams' own
+   end-to-end budgets (``FleetRouter.stream_drain_extension``): the
+   budget sheds them server-side, so the drain still terminates;
 3. ``POST /v1/reload`` on the replica and verify ``GET /healthz``
    answers (and reports the expected step, when one was requested);
 4. re-admit it, then move to the next replica — at most one replica is
@@ -42,6 +45,13 @@ log = logging.getLogger("horovod_tpu.fleet")
 #: drain wedge simulation: while injected, the rollout never observes
 #: the draining replica as idle, so the drain deadline is what saves it
 _FP_DRAIN = _faults.FaultPoint("fleet.drain")
+
+#: slack added on top of a draining stream's remaining budget: the
+#: server sheds the stream AT the budget, but delivering the shed
+#: (finishing the in-flight decode step, flushing the terminal record,
+#: the router's bookkeeping) takes a beat more — without it the drain
+#: would abort at the exact instant the stream is being released
+_SHED_GRACE_S = 1.0
 
 _M_ROLLOUTS = _metrics.counter(
     "hvd_tpu_fleet_rollouts_total",
@@ -97,7 +107,20 @@ def rolling_reload(router, step: Optional[int] = None,
                  replica_id, router.outstanding(replica_id))
         deadline_ts = time.monotonic() + max(0.0, drain_deadline)
         drained = False
-        while time.monotonic() < deadline_ts:
+        while True:
+            now = time.monotonic()
+            if now >= deadline_ts:
+                # a long-lived generation stream may legitimately hold
+                # the replica past the configured drain bound — but only
+                # as long as its own end-to-end budget: the budget sheds
+                # it server-side, outstanding hits 0, and the rollout
+                # proceeds. A budget-less stream gets no extension (it
+                # could hold the drain forever).
+                extension = getattr(router, "stream_drain_extension",
+                                    lambda _rid: 0.0)(replica_id)
+                if extension <= 0:
+                    break
+                deadline_ts = now + extension + _SHED_GRACE_S
             if _FP_DRAIN.check():
                 # injected wedge: in-flight work "never" finishes; keep
                 # waiting so the deadline (not the fault) decides

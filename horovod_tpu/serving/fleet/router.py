@@ -34,18 +34,58 @@ Requests carry ``X-HVD-TPU-Request-Id`` (stamped here when absent,
 forwarded to the replica, echoed in both responses) so one failed
 request is traceable across tiers.
 
-Chaos site ``fleet.route``: fired after admission, before replica
+**Request survivability** (docs/robustness.md) rides on top:
+
+* **end-to-end deadlines** — the router mints a per-request budget
+  (client ``X-HVD-TPU-Deadline-Ms`` header, else
+  ``HVD_TPU_FLEET_DEFAULT_DEADLINE_MS`` when set) and re-stamps the
+  *remaining* milliseconds on every forwarded attempt, so the replica's
+  queue/prefill/decode stages shed what can no longer finish; a 429
+  names the stage that died in ``X-HVD-TPU-Deadline-Exceeded``
+  (``route`` when the budget lapsed inside the router itself).
+* **hedged retries** — a non-streaming request still unanswered after
+  the ``HVD_TPU_FLEET_HEDGE_QUANTILE`` of observed proxy latency is
+  re-issued to a second replica; first response wins, the loser is
+  cancelled (``POST /v1/cancel``). Hedges, connect-error failovers, and
+  mid-stream resumes ALL draw from a per-tenant token-bucket retry
+  budget (``HVD_TPU_FLEET_RETRY_BUDGET_RATIO`` earned per primary
+  request, ``HVD_TPU_FLEET_RETRY_BUDGET_BURST`` cap) so a failing
+  fleet degrades to pass-through instead of amplifying into a retry
+  storm.
+* **mid-stream failover** — ``POST /v1/generate/stream`` responses are
+  journaled token by token (plus the replica's meta record carrying
+  the effective seed); when the stream is severed (replica death,
+  heartbeat ejection, injected ``fleet.stream`` fault) the router
+  re-submits ``prompt + emitted_tokens`` with ``sample_offset`` set to
+  a surviving replica and splices the continuation into the client's
+  stream — bit-identical to the uninterrupted run (seeded sampling
+  folds the key by ABSOLUTE emission ordinal; the prefix cache makes
+  re-prefill cheap). ``hvd_tpu_fleet_failovers_total{outcome}`` counts
+  resumed/failed takeovers.
+
+Every attempt carries ``X-HVD-TPU-Attempt`` (0 = primary) while the
+request id and trace parent stay UNCHANGED across re-submissions, so a
+retried request is one numbered trace, not several fresh-looking ones.
+When the last routable replica is ejected the scheduler's queue is
+flushed with fast 503s (see ``FairScheduler.flush_no_capacity``).
+
+Chaos sites: ``fleet.route`` — fired after admission, before replica
 selection; an injected error answers 503 without touching any replica
-(the router's own blast-radius drill).
+(the router's own blast-radius drill). ``fleet.stream`` — fired per
+streamed record read from the serving replica; an injected error
+severs the stream mid-generation exactly like a replica crash and must
+be absorbed by the failover resume.
 """
 
+import collections
 import json
 import logging
+import queue
 import threading
 import time
 import urllib.error
 import urllib.request
-from typing import Dict, Iterable, Mapping, Optional, Tuple, Union
+from typing import Dict, Iterable, List, Mapping, Optional, Tuple, Union
 
 from ... import _http
 from ... import _locks
@@ -55,16 +95,28 @@ from ... import metrics as _metrics
 from ... import retry as _retry
 from ... import tracing as _tracing
 from ...elastic.heartbeat import HeartbeatSender, LivenessMonitor
-from .tenancy import FairScheduler, TenantQuotaError, TenantRegistry
-from ..batcher import DeadlineExceededError
+from .tenancy import (FairScheduler, NoCapacityError, RetryBudget,
+                      TenantQuotaError, TenantRegistry)
+from ..batcher import (DEADLINE_HEADER, DEADLINE_STAGE_HEADER,
+                       DeadlineExceededError)
 
 log = logging.getLogger("horovod_tpu.fleet")
 
 HEARTBEAT_PATH = "/fleet/heartbeat/"
 REQUEST_ID_HEADER = "X-HVD-TPU-Request-Id"
 
+#: proxy latency samples kept for the hedge-delay quantile
+_LATENCY_WINDOW = 256
+#: samples required before hedging arms (a quantile over less is noise)
+_MIN_HEDGE_SAMPLES = 8
+
 _FP_ROUTE = _faults.FaultPoint("fleet.route")
 _FP_HEALTH = _faults.FaultPoint("fleet.health",
+                                exc=_faults.InjectedTransientFault)
+# mid-stream kill drill: fired for every record the router reads off a
+# replica's generation stream; an injected error severs the stream at
+# exactly that token — the failover-resume path must absorb it
+_FP_STREAM = _faults.FaultPoint("fleet.stream",
                                 exc=_faults.InjectedTransientFault)
 
 _M_OUTSTANDING = _metrics.gauge(
@@ -85,6 +137,19 @@ _M_REQUESTS = _metrics.counter(
     "quota/deadline, 503 no routable replica or injected fleet.route, "
     "plus replica codes relayed verbatim.",
     labels=("code",))
+_M_FAILOVERS = _metrics.counter(
+    "hvd_tpu_fleet_failovers_total",
+    "Mid-stream generation takeovers after a severed stream: resumed "
+    "(a surviving replica delivered the continuation's first token) or "
+    "failed (no surviving replica / retry budget exhausted / the "
+    "resume was rejected).",
+    labels=("outcome",))
+_M_HEDGES = _metrics.counter(
+    "hvd_tpu_fleet_hedges_total",
+    "Hedged retries: launched (primary outlived the hedge quantile and "
+    "a second replica was raced) and won (the hedge's response is the "
+    "one the client got; the primary was cancelled).",
+    labels=("outcome",))
 
 
 class _Replica:
@@ -138,13 +203,15 @@ class _RouterHandler(_http.QuietHandler):
             else:
                 self._send(404, {"error": f"unknown replica {replica_id!r}"})
             return
-        if path not in ("/v1/infer", "/v1/generate"):
+        if path not in ("/v1/infer", "/v1/generate",
+                        "/v1/generate/stream"):
             self._send(404, {"error": "not found"})
             return
         self.server.router._proxy(self, path)
 
     def _send(self, code: int, doc: dict,
-              request_id: Optional[str] = None) -> None:
+              request_id: Optional[str] = None,
+              headers: Optional[dict] = None) -> None:
         if request_id and code >= 400 and "request_id" not in doc:
             # error bodies carry the request id too: a client that lost
             # the headers (proxies, log scrapers) can still correlate
@@ -157,6 +224,9 @@ class _RouterHandler(_http.QuietHandler):
             self.send_header("Content-Length", str(len(body)))
             if request_id:
                 self.send_header(REQUEST_ID_HEADER, request_id)
+            for k, v in (headers or {}).items():
+                if v is not None:
+                    self.send_header(k, str(v))
             self.end_headers()
             self.wfile.write(body)
         except OSError:
@@ -218,6 +288,17 @@ class FleetRouter:
         self.tenants = tenants if tenants is not None else TenantRegistry(
             cfg=cfg)
         self.scheduler = FairScheduler(capacity_fn=self._capacity)
+        self.retry_budget = RetryBudget()
+        self._default_deadline_ms = float(
+            cfg.get(_config.FLEET_DEFAULT_DEADLINE_MS))
+        self._hedge_quantile = float(cfg.get(_config.FLEET_HEDGE_QUANTILE))
+        #: successful proxy latencies (seconds), the hedge-delay sample
+        self._latencies: "collections.deque" = collections.deque(
+            maxlen=_LATENCY_WINDOW)
+        #: replica id -> {request_id: budget_ts or None} for active
+        #: generation streams — rolling_reload bounds a draining
+        #: replica's wait by the streams' own end-to-end budgets
+        self._active_streams: Dict[str, Dict[str, Optional[float]]] = {}
         hb_interval = float(cfg.get(_config.FLEET_HEARTBEAT_INTERVAL)
                             if heartbeat_interval is None
                             else heartbeat_interval)
@@ -315,7 +396,30 @@ class FleetRouter:
         with self._lock:
             self._replicas[replica_id].draining = bool(draining)
             self._recount_locked()
-        self.scheduler.kick()
+        self._kick_scheduler()
+
+    def stream_drain_extension(self, replica_id: str) -> float:
+        """Seconds until the last active generation stream on
+        ``replica_id`` must shed at its own end-to-end budget (0.0 =
+        no budgeted stream). ``rolling_reload`` adds this to its drain
+        bound: a long-lived stream may legitimately hold a draining
+        replica, but only as long as its budget allows."""
+        with self._lock:
+            budgets = list(self._active_streams.get(replica_id,
+                                                    {}).values())
+        now = time.monotonic()
+        finite = [b for b in budgets if b is not None]
+        return max([0.0] + [b - now for b in finite])
+
+    def _stream_enter(self, replica_id: str, request_id: str,
+                      budget_ts: Optional[float]) -> None:
+        with self._lock:
+            self._active_streams.setdefault(replica_id,
+                                            {})[request_id] = budget_ts
+
+    def _stream_exit(self, replica_id: str, request_id: str) -> None:
+        with self._lock:
+            self._active_streams.get(replica_id, {}).pop(request_id, None)
 
     # -- health state transitions --------------------------------------------
     def _recount_locked(self) -> None:
@@ -328,6 +432,18 @@ class FleetRouter:
         # set_draining -> scheduler.kick)
         return self._routable_count * self._per_replica
 
+    def _kick_scheduler(self) -> None:
+        """Re-run grants after a capacity change; when the change took
+        the fleet to ZERO routable replicas, flush the queue with fast
+        503s — every queued waiter would otherwise sit out its own
+        deadline against a fleet that cannot dispatch anything. The
+        flush is an explicit transition signal, never inferred from a
+        capacity_fn()==0 read: a scheduler constructed with zero
+        capacity (unit tests, pre-start wiring) must still queue."""
+        self.scheduler.kick()
+        if self._routable_count == 0:
+            self.scheduler.flush_no_capacity()
+
     def _on_replica_dead(self, replica_id: str, _meta: str) -> None:
         with self._lock:
             replica = self._replicas.get(replica_id)
@@ -339,7 +455,7 @@ class FleetRouter:
         log.warning("fleet: no heartbeat from replica %s for more than "
                     "%.1fs; ejecting it from routing", replica_id,
                     self.monitor.timeout)
-        self.scheduler.kick()
+        self._kick_scheduler()
 
     def _on_replica_alive(self, replica_id: str) -> None:
         with self._lock:
@@ -376,7 +492,7 @@ class FleetRouter:
             log.warning("fleet: replica %s failed %d consecutive requests; "
                         "circuit opened (half-open probes scheduled)",
                         replica_id, self._circuit_threshold)
-            self.scheduler.kick()
+            self._kick_scheduler()
 
     def _note_success(self, replica_id: str) -> None:
         closed = False
@@ -458,6 +574,9 @@ class FleetRouter:
             handler._send(400, {"error": "bad request body"}, request_id)
             return
         tenant = self.tenants.resolve(handler.headers)
+        # every primary request earns its tenant retry-budget tokens —
+        # the denominator of the "retries <= ratio * traffic" contract
+        self.retry_budget.note_request(tenant.name)
         # the root span of a traced request's cross-host timeline: every
         # downstream hop (admission, replica server, batcher, collective)
         # nests under it via the propagated context
@@ -472,17 +591,35 @@ class FleetRouter:
                 handler._send(503, {"error": "no routable replicas"},
                               request_id)
                 return
-            deadline_ts = None
-            deadline_ms = handler.headers.get("X-HVD-TPU-Deadline-Ms")
-            if deadline_ms is None:
-                deadline_ms = _config.live_config().get(
-                    _config.SERVING_DEADLINE_MS)
-            try:
-                if float(deadline_ms) > 0:
-                    deadline_ts = time.monotonic() \
-                        + float(deadline_ms) / 1e3
-            except (TypeError, ValueError):
-                pass
+            # end-to-end budget: the client's X-HVD-TPU-Deadline-Ms
+            # header wins, else the fleet default knob mints one; with
+            # neither, the legacy SERVING_DEADLINE_MS still bounds the
+            # queue wait but nothing is propagated downstream
+            budget_ts = None
+            raw_ms = handler.headers.get(DEADLINE_HEADER)
+            if raw_ms is None and self._default_deadline_ms > 0:
+                raw_ms = self._default_deadline_ms
+            if raw_ms is not None:
+                try:
+                    budget_ms = float(raw_ms)
+                except (TypeError, ValueError):
+                    handler._send(400, {"error": f"bad {DEADLINE_HEADER} "
+                                        f"header: {raw_ms!r}"}, request_id)
+                    return
+                if budget_ms <= 0:
+                    handler._send(
+                        429, {"error": "end-to-end deadline already "
+                              "spent at the router", "stage": "route"},
+                        request_id,
+                        headers={DEADLINE_STAGE_HEADER: "route"})
+                    return
+                budget_ts = time.monotonic() + budget_ms / 1e3
+            deadline_ts = budget_ts
+            if deadline_ts is None:
+                legacy_ms = float(_config.live_config().get(
+                    _config.SERVING_DEADLINE_MS) or 0)
+                if legacy_ms > 0:
+                    deadline_ts = time.monotonic() + legacy_ms / 1e3
             try:
                 with _tracing.span("router.admission",
                                    args={"tenant": tenant.name}):
@@ -491,17 +628,131 @@ class FleetRouter:
                 handler._send(429, {"error": str(e), "tenant": tenant.name},
                               request_id)
                 return
-            except DeadlineExceededError as e:
-                handler._send(429, {"error": str(e), "tenant": tenant.name},
+            except NoCapacityError as e:
+                handler._send(503, {"error": str(e), "tenant": tenant.name},
                               request_id)
                 return
+            except DeadlineExceededError as e:
+                handler._send(429, {"error": str(e), "tenant": tenant.name},
+                              request_id,
+                              headers={DEADLINE_STAGE_HEADER:
+                                       getattr(e, "stage", None)})
+                return
             try:
-                self._forward(handler, path, body, request_id, tenant.name)
+                if path == "/v1/generate/stream":
+                    self._forward_stream(handler, path, body, request_id,
+                                         tenant.name, budget_ts)
+                else:
+                    self._forward(handler, path, body, request_id,
+                                  tenant.name, budget_ts)
             finally:
                 self.scheduler.release(tenant)
 
+    # -- forwarding helpers --------------------------------------------------
+    def _budget_left_ms(self, budget_ts: Optional[float]) -> Optional[float]:
+        return None if budget_ts is None \
+            else (budget_ts - time.monotonic()) * 1e3
+
+    def _headers_for(self, request_id: str, attempt: int,
+                     budget_ts: Optional[float]) -> dict:
+        """Per-attempt forward headers: the request id and trace parent
+        are IDENTICAL across attempts (one trace, not N); the attempt
+        ordinal and the re-stamped remaining budget differ."""
+        headers = {"Content-Type": "application/json",
+                   REQUEST_ID_HEADER: request_id,
+                   _tracing.ATTEMPT_HEADER: str(attempt)}
+        ctx = _tracing.current()
+        if ctx is not None:
+            # sampled request: hand the replica our span as parent so
+            # its server span nests under this proxy hop
+            headers[_tracing.TRACE_PARENT_HEADER] = ctx.encode()
+        left = self._budget_left_ms(budget_ts)
+        if left is not None:
+            headers[DEADLINE_HEADER] = f"{max(left, 0.0):.3f}"
+        return headers
+
+    def _budget_died(self, handler: _RouterHandler,
+                     request_id: str) -> None:
+        handler._send(429, {"error": "end-to-end deadline spent at the "
+                            "router", "stage": "route"}, request_id,
+                      headers={DEADLINE_STAGE_HEADER: "route"})
+
+    def _hedge_delay(self) -> Optional[float]:
+        """Seconds to wait on the primary before hedging; None while
+        hedging is disabled (knob 0) or the latency sample is thin."""
+        if self._hedge_quantile <= 0:
+            return None
+        with self._lock:
+            if len(self._latencies) < _MIN_HEDGE_SAMPLES:
+                return None
+            lat = sorted(self._latencies)
+        idx = min(len(lat) - 1, int(self._hedge_quantile * len(lat)))
+        return max(1e-3, lat[idx])
+
+    def _note_latency(self, seconds: float) -> None:
+        with self._lock:
+            self._latencies.append(seconds)
+
+    def _cancel_on(self, replica: _Replica, request_id: str) -> None:
+        """Fire-and-forget loser cancel: tell ``replica`` to stop
+        generating for ``request_id`` (asynchronous and idempotent on
+        the serving side; a dead replica just drops it)."""
+        def post():
+            try:
+                req = urllib.request.Request(
+                    replica.base_url + "/v1/cancel",
+                    data=json.dumps({"request_id": request_id}).encode(),
+                    method="POST",
+                    headers={"Content-Type": "application/json"})
+                with urllib.request.urlopen(req, timeout=2.0):
+                    pass
+            except Exception:  # noqa: BLE001 — best-effort by design
+                pass
+        threading.Thread(target=post, name="hvd-fleet-cancel",
+                         daemon=True).start()
+
+    def _attempt(self, replica: _Replica, path: str, body: bytes,
+                 headers: dict, attempt: int, results: "queue.Queue",
+                 trace_ctx) -> None:
+        """One forwarded attempt, run on its own thread so hedges can
+        race; the outcome tuple is
+        ``(attempt, replica, code, payload, stage, exc)``."""
+        t0 = time.monotonic()
+        req = urllib.request.Request(replica.base_url + path, data=body,
+                                     method="POST", headers=headers)
+        try:
+            with urllib.request.urlopen(
+                    req, timeout=self._request_timeout) as resp:
+                payload, code, stage = resp.read(), resp.status, None
+            self._note_success(replica.id)
+        except urllib.error.HTTPError as e:
+            # the replica answered: relay its verdict. 5xx also feeds
+            # the circuit (server sickness); 4xx is the client's own.
+            payload, code = e.read(), e.code
+            stage = e.headers.get(DEADLINE_STAGE_HEADER)
+            if code >= 500:
+                self._note_failure(replica.id)
+            else:
+                self._note_success(replica.id)
+        except Exception as e:  # noqa: BLE001 — connect/read failure
+            self._note_failure(replica.id)
+            self._done(replica)
+            results.put((attempt, replica, None, None, None, e))
+            return
+        finally:
+            if trace_ctx is not None:
+                # attempt-numbered span in the REQUEST's trace: retries
+                # and hedges are visible as siblings, not new requests
+                _tracing.emit_span(trace_ctx, "router.attempt", t0,
+                                   time.monotonic(),
+                                   args={"attempt": attempt,
+                                         "replica": replica.id})
+        self._done(replica)
+        results.put((attempt, replica, code, payload, stage, None))
+
     def _forward(self, handler: _RouterHandler, path: str, body: bytes,
-                 request_id: str, tenant_name: str) -> None:
+                 request_id: str, tenant_name: str,
+                 budget_ts: Optional[float]) -> None:
         try:
             _FP_ROUTE.fire()
         except _faults.InjectedFault as e:
@@ -510,7 +761,14 @@ class FleetRouter:
             handler._send(503, {"error": f"router fault: {e}"}, request_id)
             return
         exclude = set()
+        attempt = 0
+        t_start = time.monotonic()
+        ctx = _tracing.current()
         while True:
+            left = self._budget_left_ms(budget_ts)
+            if left is not None and left <= 0:
+                self._budget_died(handler, request_id)
+                return
             replica = self._pick(exclude)
             if replica is None:
                 log.warning("fleet: request %s (tenant %s): no routable "
@@ -518,56 +776,369 @@ class FleetRouter:
                 handler._send(503, {"error": "no routable replicas"},
                               request_id)
                 return
-            headers = {"Content-Type": "application/json",
-                       REQUEST_ID_HEADER: request_id}
-            ctx = _tracing.current()
-            if ctx is not None:
-                # sampled request: hand the replica our span as parent so
-                # its server span nests under this proxy hop
-                headers[_tracing.TRACE_PARENT_HEADER] = ctx.encode()
-            req = urllib.request.Request(
-                replica.base_url + path, data=body, method="POST",
-                headers=headers)
+            results: "queue.Queue" = queue.Queue()
+            arms = {attempt: replica}
+            primary_attempt = attempt
+            threading.Thread(
+                target=self._attempt,
+                args=(replica, path, body,
+                      self._headers_for(request_id, attempt, budget_ts),
+                      attempt, results, ctx),
+                name="hvd-fleet-attempt", daemon=True).start()
+            first = None
+            delay = self._hedge_delay()
+            if delay is not None:
+                try:
+                    first = results.get(timeout=delay)
+                except queue.Empty:
+                    # slow primary: race a second replica — if the
+                    # tenant still has retry budget and the fleet has a
+                    # second replica to spare
+                    if self.retry_budget.try_spend(tenant_name):
+                        hedge = self._pick(exclude | {replica.id})
+                        if hedge is not None:
+                            attempt += 1
+                            _M_HEDGES.labels(outcome="launched").inc()
+                            threading.Thread(
+                                target=self._attempt,
+                                args=(hedge, path, body,
+                                      self._headers_for(request_id,
+                                                        attempt,
+                                                        budget_ts),
+                                      attempt, results, ctx),
+                                name="hvd-fleet-hedge",
+                                daemon=True).start()
+                            arms[attempt] = hedge
+            winner = None
+            pending = len(arms)
+            while pending:
+                res = first if first is not None else results.get()
+                first = None
+                pending -= 1
+                arm, used, code, payload, stage, exc = res
+                if exc is None:
+                    winner = res
+                    break
+                exclude.add(used.id)
+                log.warning("fleet: request %s: replica %s unreachable "
+                            "(%s); failing over", request_id, used.id, exc)
+            if winner is not None:
+                arm, used, code, payload, stage, _ = winner
+                for other_arm, other in arms.items():
+                    if other_arm != arm:
+                        # first response wins; the loser (in flight or
+                        # already done — cancel is idempotent) stops
+                        # burning decode on an answer nobody will read
+                        self._cancel_on(other, request_id)
+                if len(arms) > 1 and arm != primary_attempt:
+                    _M_HEDGES.labels(outcome="won").inc()
+                if code < 500:
+                    self._note_latency(time.monotonic() - t_start)
+                self._relay(handler, code, payload, request_id,
+                            headers={DEADLINE_STAGE_HEADER: stage})
+                return
+            # every arm died on connect: the next attempt is a RETRY
+            # and must buy its way in — an exhausted budget degrades to
+            # pass-through (relay the failure) instead of storming
+            if not self.retry_budget.try_spend(tenant_name):
+                log.warning("fleet: request %s (tenant %s): retry budget "
+                            "exhausted; passing the failure through",
+                            request_id, tenant_name)
+                handler._send(503, {"error": "replica unreachable and "
+                                    "tenant retry budget exhausted"},
+                              request_id)
+                return
+            attempt += 1
+
+    # -- streaming proxy (journal + mid-stream failover) ---------------------
+    def _forward_stream(self, handler: _RouterHandler, path: str,
+                        body: bytes, request_id: str, tenant_name: str,
+                        budget_ts: Optional[float]) -> None:
+        try:
+            _FP_ROUTE.fire()
+        except _faults.InjectedFault as e:
+            log.warning("fleet: request %s (tenant %s) failed at the "
+                        "router: %s", request_id, tenant_name, e)
+            handler._send(503, {"error": f"router fault: {e}"}, request_id)
+            return
+        try:
+            doc = json.loads(body) if body.strip() else {}
+            orig_max = int(doc.get("max_tokens", 16))
+            base_offset = int(doc.get("sample_offset", 0))
+        except (ValueError, TypeError):
+            handler._send(400, {"error": "bad request body"}, request_id)
+            return
+        journal = _StreamJournal(doc, orig_max, base_offset)
+        exclude = set()
+        attempt = 0
+        started = False    # client headers (and meta record) sent
+        while True:
+            left = self._budget_left_ms(budget_ts)
+            if left is not None and left <= 0:
+                if started:
+                    self._stream_fail(handler, 429, "end-to-end deadline "
+                                      "spent at the router", request_id,
+                                      stage="route")
+                else:
+                    self._budget_died(handler, request_id)
+                return
+            replica = self._pick(exclude)
+            if replica is None:
+                self._takeover_failed(handler, started, request_id,
+                                      "no surviving replica to resume on"
+                                      if started else
+                                      "no routable replicas",
+                                      count=started)
+                return
+            outcome = None
+            self._stream_enter(replica.id, request_id, budget_ts)
             try:
-                with urllib.request.urlopen(
-                        req, timeout=self._request_timeout) as resp:
-                    payload, code = resp.read(), resp.status
-            except urllib.error.HTTPError as e:
-                # the replica answered: relay its verdict. 5xx also feeds
-                # the circuit (server sickness); 4xx is the client's own.
-                payload, code = e.read(), e.code
+                outcome = self._stream_attempt(
+                    handler, replica, path, journal,
+                    self._headers_for(request_id, attempt, budget_ts),
+                    attempt, request_id, started)
+            finally:
+                self._stream_exit(replica.id, request_id)
+                self._done(replica)
+            kind = outcome[0]
+            if kind == "done":
+                self._note_success(replica.id)
+                return
+            if kind == "client_gone":
+                # the CLIENT went away: stop the replica's decode, keep
+                # the replica (it did nothing wrong)
+                self._note_success(replica.id)
+                self._cancel_on(replica, request_id)
+                return
+            if kind == "rejected":
+                # the replica ANSWERED with a verdict pre-stream
+                code, payload, stage = outcome[1]
                 if code >= 500:
                     self._note_failure(replica.id)
                 else:
                     self._note_success(replica.id)
-                self._done(replica)
-                self._relay(handler, code, payload, request_id)
-                return
-            except Exception as e:  # noqa: BLE001 — connect/read failure
+                if not started:
+                    if code < 500:
+                        self._relay(handler, code, payload, request_id,
+                                    headers={DEADLINE_STAGE_HEADER: stage})
+                        return
+                    # a 5xx before any stream: ordinary failover
+                elif code < 500:
+                    # mid-failover resume rejected with a client-class
+                    # verdict (429 deadline, 400): the takeover failed
+                    self._takeover_failed(
+                        handler, started, request_id,
+                        f"resume rejected by replica {replica.id} "
+                        f"({code})", count=True)
+                    return
+            else:   # "severed" — connect error, mid-stream EOF, fault
+                started = started or outcome[1]
                 self._note_failure(replica.id)
-                self._done(replica)
-                exclude.add(replica.id)
-                log.warning("fleet: request %s: replica %s unreachable "
-                            "(%s); failing over", request_id, replica.id, e)
-                continue
-            self._note_success(replica.id)
-            self._done(replica)
-            self._relay(handler, code, payload, request_id)
-            return
+                log.warning("fleet: request %s: stream severed on "
+                            "replica %s (%s); attempting takeover",
+                            request_id, replica.id, outcome[2])
+            exclude.add(replica.id)
+            # a takeover attempt is a RETRY: it buys its way in or the
+            # failure passes through
+            if not self.retry_budget.try_spend(tenant_name):
+                self._takeover_failed(handler, started, request_id,
+                                      "tenant retry budget exhausted",
+                                      count=started)
+                return
+            attempt += 1
+
+    def _stream_attempt(self, handler: _RouterHandler, replica: _Replica,
+                        path: str, journal: "_StreamJournal",
+                        headers: dict, attempt: int, request_id: str,
+                        started: bool):
+        """One streaming attempt against ``replica``; returns
+        ``("done",)``, ``("client_gone",)``,
+        ``("rejected", (code, payload, stage))`` or
+        ``("severed", started, reason)``. Forwards records to the
+        client as they arrive; on a resumed attempt (``attempt > 0``)
+        the replica's meta record is swallowed — the client already
+        has the original."""
+        req = urllib.request.Request(
+            replica.base_url + path, data=journal.request_body(attempt),
+            method="POST", headers=headers)
+        t0 = time.monotonic()
+        try:
+            resp = urllib.request.urlopen(req,
+                                          timeout=self._request_timeout)
+        except urllib.error.HTTPError as e:
+            return ("rejected", (e.code, e.read(),
+                                 e.headers.get(DEADLINE_STAGE_HEADER)))
+        except Exception as e:  # noqa: BLE001 — connect failure
+            return ("severed", started, f"connect: {e}")
+        resumed_unmarked = attempt > 0
+        try:
+            with resp:
+                while True:
+                    try:
+                        line = resp.readline()
+                        if line:
+                            # the mid-stream kill drill: an injected
+                            # error here severs the stream at exactly
+                            # this record, like the replica dying
+                            _FP_STREAM.fire()
+                    except Exception as e:  # noqa: BLE001 — read failure
+                        return ("severed", started, f"read: {e}")
+                    if not line:
+                        # EOF without a terminal record: the replica
+                        # died with the stream open
+                        return ("severed", started,
+                                "EOF before terminal record")
+                    try:
+                        rec = json.loads(line)
+                    except ValueError:
+                        return ("severed", started, "corrupt record")
+                    if "meta" in rec:
+                        journal.note_meta(rec["meta"])
+                        if attempt == 0:
+                            if not self._stream_start(handler,
+                                                      request_id, line):
+                                return ("client_gone",)
+                            started = True
+                        continue
+                    if "t" in rec:
+                        journal.note_token(rec["t"])
+                        if resumed_unmarked:
+                            # the takeover is real the moment the
+                            # surviving replica speaks
+                            _M_FAILOVERS.labels(outcome="resumed").inc()
+                            resumed_unmarked = False
+                        if not self._stream_write(handler, line):
+                            return ("client_gone",)
+                        continue
+                    if "error" in rec \
+                            and int(rec.get("code") or 500) >= 500:
+                        # the replica reported its own death in-band (a
+                        # dying server flushes a 500 record before the
+                        # socket drops): that is a severed stream, not
+                        # a verdict — the takeover can still save the
+                        # request. 4xx records (deadline, cancel) are
+                        # the request's own and genuinely terminal.
+                        return ("severed", started,
+                                f"replica error record "
+                                f"({rec.get('code')}): {rec.get('error')}")
+                    # terminal record ("done" or a 4xx in-stream
+                    # "error"): the stream ended cleanly — relay, finish
+                    self._stream_write(handler, line)
+                    if attempt == 0 and not started:
+                        # error before meta should not happen, but
+                        # never leave the client headerless
+                        pass
+                    self._note_latency(time.monotonic() - t0)
+                    return ("done",)
+        finally:
+            try:
+                resp.close()
+            except Exception:  # noqa: BLE001
+                pass
+
+    def _stream_start(self, handler: _RouterHandler, request_id: str,
+                      meta_line: bytes) -> bool:
+        """Commit the client response as a stream (200 + NDJSON) and
+        forward the meta record; False = client already gone."""
+        _M_REQUESTS.labels(code="200").inc()
+        try:
+            handler.send_response(200)
+            handler.send_header("Content-Type", "application/x-ndjson")
+            handler.send_header(REQUEST_ID_HEADER, request_id)
+            handler.send_header("Connection", "close")
+            handler.close_connection = True
+            handler.end_headers()
+        except OSError:
+            return False
+        return self._stream_write(handler, meta_line)
+
+    @staticmethod
+    def _stream_write(handler: _RouterHandler, line: bytes) -> bool:
+        try:
+            handler.wfile.write(line if line.endswith(b"\n")
+                                else line + b"\n")
+            handler.wfile.flush()
+            return True
+        except OSError:
+            return False
+
+    def _stream_fail(self, handler: _RouterHandler, code: int,
+                     message: str, request_id: str,
+                     stage: Optional[str] = None) -> None:
+        """Terminal failure for a stream that already committed its 200:
+        an in-band error record (the client distinguishes it from a
+        severed stream by its presence)."""
+        self._stream_write(handler, json.dumps(
+            {"error": message, "code": code, "stage": stage,
+             "request_id": request_id}).encode("utf-8"))
+
+    def _takeover_failed(self, handler: _RouterHandler, started: bool,
+                         request_id: str, reason: str,
+                         count: bool) -> None:
+        if count:
+            _M_FAILOVERS.labels(outcome="failed").inc()
+        log.warning("fleet: request %s: stream takeover failed: %s",
+                    request_id, reason)
+        if started:
+            self._stream_fail(handler, 503, reason, request_id)
+        else:
+            handler._send(503, {"error": reason}, request_id)
 
     @staticmethod
     def _relay(handler: _RouterHandler, code: int, payload: bytes,
-               request_id: str) -> None:
+               request_id: str, headers: Optional[dict] = None) -> None:
         _M_REQUESTS.labels(code=str(code)).inc()
         try:
             handler.send_response(code)
             handler.send_header("Content-Type", "application/json")
             handler.send_header("Content-Length", str(len(payload)))
             handler.send_header(REQUEST_ID_HEADER, request_id)
+            for k, v in (headers or {}).items():
+                if v is not None:
+                    handler.send_header(k, str(v))
             handler.end_headers()
             handler.wfile.write(payload)
         except OSError:
             handler.close_connection = True
+
+
+class _StreamJournal:
+    """Router-side journal of one streaming generation: the original
+    request document plus everything the replica has emitted, enough to
+    re-submit ``prompt + emitted`` elsewhere and continue bit-identically.
+
+    The meta record supplies the one fact the router cannot know ahead
+    of time — the EFFECTIVE seed (a seedless submit defaults to the
+    replica-local sequence id) — and the resume document pins it, sets
+    ``sample_offset`` to the absolute emission ordinal (PR 11's
+    ``fold_in(key, emitted)`` continues the original sampled stream),
+    and shrinks ``max_tokens`` by what was already delivered."""
+
+    def __init__(self, doc: dict, orig_max: int, base_offset: int):
+        self._doc = doc
+        self._orig_max = orig_max
+        self._base_offset = base_offset
+        self._seed: Optional[int] = None
+        self.tokens: List[int] = []
+
+    def note_meta(self, meta: dict) -> None:
+        if self._seed is None and isinstance(meta, dict):
+            seed = meta.get("seed")
+            self._seed = None if seed is None else int(seed)
+
+    def note_token(self, token: int) -> None:
+        self.tokens.append(int(token))
+
+    def request_body(self, attempt: int) -> bytes:
+        if attempt == 0:
+            return json.dumps(self._doc).encode("utf-8")
+        doc = dict(self._doc)
+        doc["prompt"] = list(self._doc.get("prompt", [])) + self.tokens
+        doc["max_tokens"] = max(1, self._orig_max - len(self.tokens))
+        doc["sample_offset"] = self._base_offset + len(self.tokens)
+        if self._seed is not None:
+            doc["seed"] = self._seed
+        return json.dumps(doc).encode("utf-8")
 
 
 class _RouterBeatClient:

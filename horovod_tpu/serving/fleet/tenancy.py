@@ -18,10 +18,23 @@ dispatch so each tenant owns its own failure budget:
 * **weighted fair dequeue** — :class:`FairScheduler` grants fleet
   capacity by priority class first, then stride scheduling over tenant
   weights (a weight-2 tenant dequeues twice as often as a weight-1
-  tenant under contention), FIFO within a tenant. Fleet capacity is
+  tenant under contention), EDF within a tenant: among one tenant's
+  queued requests the earliest deadline dispatches first (FIFO between
+  deadline-less requests), so a near-deadline request is not starved
+  behind fresh arrivals. Fleet capacity is
   ``routable replicas x HVD_TPU_FLEET_REPLICA_CONCURRENCY``, supplied
   live by the router so ejections shrink admission instead of piling
-  requests onto dead replicas.
+  requests onto dead replicas; when capacity collapses to **zero**
+  (last replica ejected) the router flushes every queued waiter with a
+  fast :class:`NoCapacityError` (HTTP 503) instead of letting each one
+  burn its own deadline against a fleet that cannot serve it.
+* **retry budget** — :class:`RetryBudget` is the per-tenant token
+  bucket bounding router-issued retries, hedges, and mid-stream
+  failovers: each primary request earns
+  ``HVD_TPU_FLEET_RETRY_BUDGET_RATIO`` tokens (capped at
+  ``HVD_TPU_FLEET_RETRY_BUDGET_BURST``) and each retry spends one, so
+  a failing fleet degrades to pass-through instead of amplifying load
+  into a retry storm.
 
 Fairness is observable: ``hvd_tpu_fleet_tenant_admitted_total``,
 ``hvd_tpu_fleet_tenant_rejected_total{reason}``, and the per-tenant
@@ -52,9 +65,19 @@ _M_ADMITTED = _metrics.counter(
 _M_REJECTED = _metrics.counter(
     "hvd_tpu_fleet_tenant_rejected_total",
     "Requests rejected by per-tenant admission: reason=quota (the "
-    "tenant's own queue cap, HTTP 429) or reason=deadline (expired "
-    "while waiting in the fair queue, HTTP 429).",
+    "tenant's own queue cap, HTTP 429), reason=deadline (expired "
+    "while waiting in the fair queue, HTTP 429), or reason="
+    "no_capacity (queue flushed because the routable-replica count "
+    "hit zero, HTTP 503).",
     labels=("tenant", "reason"))
+_M_RETRY_BUDGET = _metrics.counter(
+    "hvd_tpu_fleet_retry_budget_total",
+    "Retry-budget decisions by the fleet router, per tenant: outcome="
+    "granted (a retry/hedge/failover spent a token) or outcome=denied "
+    "(bucket empty — the router passed the failure through instead of "
+    "retrying). A rising denied rate under fleet trouble is the "
+    "retry-storm guard doing its job.",
+    labels=("tenant", "outcome"))
 _M_QUEUE_WAIT = _metrics.histogram(
     "hvd_tpu_fleet_tenant_queue_wait_seconds",
     "Seconds an admitted request waited in the router's weighted fair "
@@ -66,6 +89,63 @@ _M_QUEUE_WAIT = _metrics.histogram(
 
 class TenantQuotaError(Exception):
     """The tenant's own queue cap is exceeded (HTTP 429)."""
+
+
+class NoCapacityError(Exception):
+    """Fleet capacity hit zero while the request was queued — the last
+    routable replica was ejected, so waiting longer can only burn the
+    client's deadline (HTTP 503, fail fast and let the client retry
+    against a fleet that may have recovered)."""
+
+
+class RetryBudget:
+    """Per-tenant token bucket bounding router-issued retries.
+
+    Every primary request earns ``ratio`` tokens
+    (``HVD_TPU_FLEET_RETRY_BUDGET_RATIO``); every retry/hedge/failover
+    spends one whole token. Buckets start (and cap) at ``burst``
+    (``HVD_TPU_FLEET_RETRY_BUDGET_BURST``), so early failures can
+    still fail over while a sustained failure rate above ``ratio`` of
+    offered load drains the bucket and the router degrades to
+    pass-through.
+    """
+
+    def __init__(self, ratio: Optional[float] = None,
+                 burst: Optional[float] = None):
+        cfg = _config.live_config()
+        self._ratio = float(cfg.get(_config.FLEET_RETRY_BUDGET_RATIO)
+                            if ratio is None else ratio)
+        self._burst = max(0.0, float(
+            cfg.get(_config.FLEET_RETRY_BUDGET_BURST)
+            if burst is None else burst))
+        self._tokens: Dict[str, float] = {}
+        self._lock = threading.Lock()
+
+    def note_request(self, tenant: str) -> None:
+        """A primary request accrues ``ratio`` tokens for its tenant."""
+        with self._lock:
+            self._tokens[tenant] = min(
+                self._burst,
+                self._tokens.get(tenant, self._burst) + self._ratio)
+
+    def try_spend(self, tenant: str) -> bool:
+        """Spend one retry token; False means the budget is exhausted
+        and the caller must pass the failure through."""
+        with self._lock:
+            tokens = self._tokens.get(tenant, self._burst)
+            if tokens >= 1.0:
+                self._tokens[tenant] = tokens - 1.0
+                granted = True
+            else:
+                granted = False
+        _M_RETRY_BUDGET.labels(
+            tenant=tenant,
+            outcome="granted" if granted else "denied").inc()
+        return granted
+
+    def tokens(self, tenant: str) -> float:
+        with self._lock:
+            return self._tokens.get(tenant, self._burst)
 
 
 @dataclass(frozen=True)
@@ -141,12 +221,24 @@ class TenantRegistry:
 
 
 class _Waiter:
-    __slots__ = ("tenant", "granted", "enqueued_at")
+    __slots__ = ("tenant", "granted", "enqueued_at", "deadline_ts",
+                 "error")
 
-    def __init__(self, tenant: Tenant, enqueued_at: float):
+    def __init__(self, tenant: Tenant, enqueued_at: float,
+                 deadline_ts: Optional[float] = None):
         self.tenant = tenant
         self.granted = False
         self.enqueued_at = enqueued_at
+        #: absolute (monotonic) deadline, None = no deadline; the
+        #: EDF-within-tenant dequeue key
+        self.deadline_ts = deadline_ts
+        #: terminal error delivered by a queue flush (capacity hit 0)
+        self.error: Optional[BaseException] = None
+
+    @property
+    def edf_key(self) -> Tuple[float, float]:
+        return (self.deadline_ts if self.deadline_ts is not None
+                else float("inf"), self.enqueued_at)
 
 
 @dataclass
@@ -208,11 +300,14 @@ class FairScheduler:
                 raise TenantQuotaError(
                     f"tenant {tenant.name!r} has {len(state.queue)} requests "
                     f"queued (cap {tenant.max_queued}); retry later")
-            waiter = _Waiter(tenant, start)
+            waiter = _Waiter(tenant, start, deadline_ts)
             state.queue.append(waiter)
             self._grant_locked()
             while not waiter.granted:
                 now = time.monotonic()
+                if waiter.error is not None:
+                    # a flush already removed the waiter from the queue
+                    raise waiter.error
                 if self._closed:
                     state.queue.remove(waiter)
                     raise RuntimeError("scheduler closed")
@@ -223,7 +318,8 @@ class FairScheduler:
                                        reason="deadline").inc()
                     raise DeadlineExceededError(
                         f"tenant {tenant.name!r}: deadline expired after "
-                        f"{now - start:.3f}s in the fair queue")
+                        f"{now - start:.3f}s in the fair queue",
+                        stage="queue")
                 wait_s = 0.05 if deadline_ts is None else max(
                     0.001, min(0.05, deadline_ts - now))
                 self._cond.wait(timeout=wait_s)
@@ -247,6 +343,30 @@ class FairScheduler:
         with self._cond:
             self._grant_locked()
 
+    def flush_no_capacity(self) -> None:
+        """Fleet capacity hit zero: fail every queued waiter with a fast
+        :class:`NoCapacityError` (HTTP 503) instead of letting each one
+        wait out its own deadline against a fleet that cannot dispatch
+        it. The router calls this when the routable-replica count
+        reaches 0; new arrivals are already fast-503'd by the router's
+        own pre-admission check. Explicitly signal-driven — a plain
+        ``capacity_fn() == 0`` reading is NOT a flush trigger, because
+        direct FairScheduler users legitimately queue against a
+        momentarily-zero capacity."""
+        with self._cond:
+            flushed = False
+            for name, state in self._states.items():
+                for waiter in state.queue:
+                    waiter.error = NoCapacityError(
+                        f"tenant {name!r}: all replicas became "
+                        f"unroutable while queued; failing fast")
+                    _M_REJECTED.labels(tenant=name,
+                                       reason="no_capacity").inc()
+                    flushed = True
+                state.queue.clear()
+            if flushed:
+                self._cond.notify_all()
+
     def close(self) -> None:
         with self._cond:
             self._closed = True
@@ -269,7 +389,14 @@ class FairScheduler:
             if best is None:
                 break
             state = self._states[best[2]]
-            waiter = state.queue.popleft()
+            # EDF within the tenant: the earliest-deadline waiter
+            # dispatches first (deadline-less waiters rank last, FIFO
+            # among themselves) — a near-deadline request is not
+            # starved behind fresh arrivals. Cross-tenant order stays
+            # priority-then-stride, so weighted fairness and the
+            # flooding-tenant isolation are unchanged.
+            waiter = min(state.queue, key=lambda w: w.edf_key)
+            state.queue.remove(waiter)
             waiter.granted = True
             state.active += 1
             self._fleet_active += 1

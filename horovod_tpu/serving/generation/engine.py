@@ -109,7 +109,9 @@ class GenerationEngine:
                top_k: Optional[int] = None,
                top_p: Optional[float] = None,
                seed: Optional[int] = None,
-               request_id: Optional[str] = None) -> GenSequence:
+               request_id: Optional[str] = None,
+               budget_ms: Optional[float] = None,
+               sample_offset: int = 0) -> GenSequence:
         """Admit one request; returns the sequence handle for
         :meth:`result` / :meth:`stream`. Raises ``QueueFullError``
         (503) / ``DeadlineExceededError`` (429) / ``ValueError``
@@ -119,16 +121,29 @@ class GenerationEngine:
         across a preemption-recompute) — see
         :meth:`ContinuousBatcher.submit`. ``request_id`` stamps the
         serving request id onto the sequence for preemption/deadline
-        attribution and per-request tracing."""
+        attribution and per-request tracing. ``budget_ms`` is the
+        end-to-end latency budget (never resets, unlike
+        ``deadline_ms``); ``sample_offset`` offsets the PRNG emission
+        ordinal so a failover resume of ``prompt + emitted`` continues
+        the original sampled stream bit-identically."""
         return self.batcher.submit(prompt, max_tokens=max_tokens,
                                    eos_id=eos_id, deadline_ms=deadline_ms,
                                    temperature=temperature, top_k=top_k,
                                    top_p=top_p, seed=seed,
-                                   request_id=request_id)
+                                   request_id=request_id,
+                                   budget_ms=budget_ms,
+                                   sample_offset=sample_offset)
 
     def result(self, seq: GenSequence,
                timeout: Optional[float] = None) -> List[int]:
         return self.batcher.result(seq, timeout=timeout)
+
+    def cancel(self, request_id: str) -> None:
+        """Flag every sequence submitted under ``request_id`` for
+        cancellation (``POST /v1/cancel``; hedging's loser-cancel
+        path). Asynchronous and idempotent — see
+        :meth:`ContinuousBatcher.cancel`."""
+        self.batcher.cancel(request_id)
 
     def stream(self, prompt: Sequence[int], max_tokens: int = 16,
                eos_id: Optional[int] = None,

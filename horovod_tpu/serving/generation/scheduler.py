@@ -167,6 +167,13 @@ _M_STEP = _metrics.histogram(
     buckets=(0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
              0.25, 1.0))
 
+class RequestCancelledError(RuntimeError):
+    """The request was cancelled via :meth:`ContinuousBatcher.cancel`
+    (``POST /v1/cancel`` — e.g. the losing arm of a hedged request).
+    The front-end answers 499; the router that issued the cancel has
+    already relayed the winning response, so no client observes it."""
+
+
 _FP_PREFILL = _faults.FaultPoint("serving.prefill")
 _FP_DECODE = _faults.FaultPoint("serving.decode")
 _FP_EVICT = _faults.FaultPoint("serving.evict")
@@ -222,18 +229,19 @@ class GenSequence:
     :meth:`ContinuousBatcher.stream` consume it."""
 
     __slots__ = ("id", "prompt", "max_tokens", "eos_id", "deadline_s",
-                 "deadline", "generated", "logprobs", "blocks",
+                 "deadline", "budget", "generated", "logprobs", "blocks",
                  "prefill_tokens", "prefilled", "cache_len", "next_input",
                  "resume_decode", "state", "error", "stream_q",
                  "done_event", "arrived_at", "temperature", "top_k",
-                 "top_p", "seed", "key", "prefix_hashes", "block_hashes",
-                 "cache_gen", "request_id", "trace")
+                 "top_p", "seed", "key", "sample_offset", "prefix_hashes",
+                 "block_hashes", "cache_gen", "request_id", "trace")
 
     def __init__(self, seq_id: int, prompt: List[int], max_tokens: int,
                  eos_id: Optional[int], deadline_s: float,
                  temperature: float = 0.0, top_k: int = 0,
                  top_p: float = 1.0, seed: Optional[int] = None,
-                 request_id: Optional[str] = None):
+                 request_id: Optional[str] = None,
+                 budget_s: float = 0.0, sample_offset: int = 0):
         self.id = seq_id
         self.prompt = list(prompt)
         self.max_tokens = int(max_tokens)
@@ -241,6 +249,19 @@ class GenSequence:
         self.deadline_s = deadline_s
         self.deadline = (time.monotonic() + deadline_s
                          if deadline_s > 0 else float("inf"))
+        #: the END-TO-END budget (X-HVD-TPU-Deadline-Ms): unlike the
+        #: per-token ``deadline`` it never resets on emission, so a
+        #: request that can no longer finish is shed at whichever stage
+        #: (queue / prefill / decode) notices first
+        self.budget = (time.monotonic() + budget_s
+                       if budget_s > 0 else float("inf"))
+        #: PRNG emission ordinal the FIRST sampled token uses — the
+        #: cross-replica resume contract: a failover re-submission of
+        #: ``prompt + emitted`` with the original seed and
+        #: ``sample_offset=len(emitted)`` continues the fold_in(key,
+        #: emitted-ordinal) chain exactly where the dead replica
+        #: stopped, making the resumed continuation bit-identical
+        self.sample_offset = int(sample_offset)
         self.temperature = float(temperature)
         self.top_k = int(top_k)
         self.top_p = float(top_p)
@@ -388,6 +409,12 @@ class ContinuousBatcher:
             "serving.generation.ContinuousBatcher._lock")
         self._thread: Optional[threading.Thread] = None
         self._stopped = False
+        #: request ids flagged for cancellation (request_id ->
+        #: monotonic registration time); the scheduler loop applies
+        #: them each iteration, unmatched ids expire after
+        #: _CANCEL_TTL_S so a cancel racing a request that never
+        #: arrives cannot leak
+        self._cancels: dict = {}
 
     # -- submission surface --------------------------------------------------
 
@@ -398,7 +425,9 @@ class ContinuousBatcher:
                top_k: Optional[int] = None,
                top_p: Optional[float] = None,
                seed: Optional[int] = None,
-               request_id: Optional[str] = None) -> GenSequence:
+               request_id: Optional[str] = None,
+               budget_ms: Optional[float] = None,
+               sample_offset: int = 0) -> GenSequence:
         """Admit one generation request. Raises
         :class:`~horovod_tpu.serving.batcher.QueueFullError` on a full
         queue (HTTP 503), ``ValueError`` for a request that could never
@@ -412,6 +441,17 @@ class ContinuousBatcher:
         prompt + same params => same tokens, including across a
         preemption-recompute). Unseeded sampled requests draw from a
         per-request key derived from the sequence id.
+
+        ``budget_ms`` is the request's remaining END-TO-END budget
+        (the X-HVD-TPU-Deadline-Ms hop contract): unlike the per-token
+        ``deadline_ms`` it never resets on emission — when it dies the
+        sequence is shed with a stage-attributed
+        :class:`~horovod_tpu.serving.batcher.DeadlineExceededError`
+        (queue / prefill / decode). ``sample_offset`` starts the
+        on-device PRNG emission ordinal past ``sample_offset`` already-
+        emitted tokens, so a failover resume of ``prompt + emitted``
+        with the original seed replays the uninterrupted continuation
+        bit-identically.
         """
         prompt = [int(t) for t in prompt]
         if not prompt:
@@ -459,11 +499,24 @@ class ContinuousBatcher:
             # negative budget is already spent — shed it now
             raise DeadlineExceededError(
                 f"request deadline_ms={deadline_ms} is negative: "
-                f"budget already spent before admission")
+                f"budget already spent before admission", stage="queue")
+        sample_offset = int(sample_offset)
+        if sample_offset < 0:
+            raise ValueError(
+                f"sample_offset={sample_offset}: must be >= 0")
+        budget_s = 0.0 if budget_ms is None else float(budget_ms) / 1e3
+        if budget_ms is not None and budget_s <= 0:
+            # an explicit end-to-end budget that is already <= 0 can
+            # never produce a token: reject at admission, before the
+            # request consumes a queue slot or a prefill chunk
+            raise DeadlineExceededError(
+                f"request budget_ms={budget_ms}: end-to-end budget "
+                f"already spent before admission", stage="queue")
         seq = GenSequence(next(self._ids), prompt, max_tokens,
                           self.eos_id if eos_id is None else eos_id,
                           ddl_s, temperature=temperature, top_k=top_k,
-                          top_p=top_p, seed=seed, request_id=request_id)
+                          top_p=top_p, seed=seed, request_id=request_id,
+                          budget_s=budget_s, sample_offset=sample_offset)
         _tracing.note_request(request_id)
         if self._prefix_cache:
             # hashed on the submitter's thread (pure computation on a
@@ -512,6 +565,19 @@ class ContinuousBatcher:
                     raise seq.error
                 return
             yield tok
+
+    def cancel(self, request_id: str) -> None:
+        """Flag the sequence submitted under ``request_id`` for
+        cancellation (best-effort, asynchronous): the scheduler loop
+        fails it with :class:`RequestCancelledError` at its next
+        iteration, freeing its batch slot and KV blocks. The hedge
+        protocol's loser-cancellation path (``POST /v1/cancel``) — a
+        cancel for an unknown/completed id is a no-op that expires
+        after a grace period."""
+        if not request_id:
+            return
+        with self._lock:
+            self._cancels[str(request_id)] = time.monotonic()
 
     def generate(self, prompt: Sequence[int], max_tokens: int = 16,
                  eos_id: Optional[int] = None,
@@ -612,6 +678,7 @@ class ContinuousBatcher:
             busy = bool(self._running or self._inflight)
             t0 = time.perf_counter()
             self._blocked_s = 0.0
+            self._apply_cancels(now)
             self._admit(now)
             self._prefill_step(now)
             self._decode_step(now)
@@ -641,6 +708,38 @@ class ContinuousBatcher:
         _M_RUNNING.set(len(self._running))
         _M_WAITING.set(len(self._waiting) + self._q.qsize())
 
+    #: seconds an unmatched cancellation id survives before it is
+    #: dropped (covers a cancel racing a submit in flight)
+    _CANCEL_TTL_S = 30.0
+
+    def _apply_cancels(self, now: float) -> None:
+        """Fail every waiting/running sequence whose request id was
+        :meth:`cancel`-flagged. In-flight decode steps drain first:
+        their tokens are legitimate work for the surviving lanes, and
+        the membership change must not race the pipeline."""
+        with self._lock:
+            if not self._cancels:
+                return
+            cancels = dict(self._cancels)
+        hit = [s for s in self._running + self._waiting
+               if s.request_id is not None and s.request_id in cancels]
+        if hit:
+            self._flush_inflight()
+        applied = set()
+        for s in hit:
+            if s.state == "done":
+                continue
+            if s in self._waiting:
+                self._waiting.remove(s)
+            applied.add(s.request_id)
+            self._deliver_error(s, RequestCancelledError(
+                f"request {s.request_id} cancelled (sequence {s.id})"))
+        with self._lock:
+            for rid in [r for r, t in self._cancels.items()
+                        if r in applied
+                        or now - t > self._CANCEL_TTL_S]:
+                del self._cancels[rid]
+
     # -- admission -----------------------------------------------------------
 
     def _admit(self, now: float) -> None:
@@ -664,12 +763,15 @@ class ContinuousBatcher:
         gate is per-sequence instantaneous state, not a reservation;
         the prefill/decode growth path still backstops any shortfall
         with preemption, exactly as before."""
-        for s in [x for x in self._waiting if now > x.deadline]:
+        for s in [x for x in self._waiting
+                  if now > x.deadline or now > x.budget]:
             self._waiting.remove(s)
+            which = ("end-to-end budget" if now > s.budget else "deadline")
             self._deliver_error(s, DeadlineExceededError(
-                f"deadline expired before sequence {s.id} could "
+                f"{which} expired before sequence {s.id} could "
                 f"{'resume' if s.resume_decode else 'start'}"
-                + (f" (request {s.request_id})" if s.request_id else "")))
+                + (f" (request {s.request_id})" if s.request_id else ""),
+                stage="queue"))
         while self._waiting:
             s = self._waiting[0]
             if len(self._running) >= self.max_seqs:
@@ -710,15 +812,20 @@ class ContinuousBatcher:
         device time for a client that already gave up. Any in-flight
         step is drained first: a token it delivers resets that
         sequence's deadline, so only genuinely starved sequences shed."""
-        if not any(now > x.deadline for x in self._running):
+        if not any(now > x.deadline or now > x.budget
+                   for x in self._running):
             return
         self._flush_inflight()
-        for s in [x for x in self._running if now > x.deadline]:
+        for s in [x for x in self._running
+                  if now > x.deadline or now > x.budget]:
             if s.state != "done":
+                which = ("end-to-end budget" if now > s.budget
+                         else "deadline")
                 self._deliver_error(s, DeadlineExceededError(
-                    f"deadline expired before sequence {s.id}'s next "
+                    f"{which} expired before sequence {s.id}'s next "
                     f"token"
-                    + (f" (request {s.request_id})" if s.request_id else "")))
+                    + (f" (request {s.request_id})" if s.request_id else ""),
+                    stage="prefill" if s.state == "prefill" else "decode"))
 
     def _prefill_step(self, now: float) -> None:
         self._expire_running(now)
@@ -747,7 +854,7 @@ class ContinuousBatcher:
             top_k=jnp.asarray([s.top_k], jnp.int32),
             top_p=jnp.asarray([s.top_p], jnp.float32),
             key=jnp.asarray(s.key[None, :]),
-            emitted=jnp.zeros((1,), jnp.int32))
+            emitted=jnp.asarray([s.sample_offset], jnp.int32))
         if s.request_id:
             _tracing.note_request(s.request_id)
         try:
@@ -919,7 +1026,7 @@ class ContinuousBatcher:
             top_k[i] = s.top_k
             top_p[i] = s.top_p
             key[i] = s.key
-            emitted[i] = len(s.generated)
+            emitted[i] = s.sample_offset + len(s.generated)
         self._dstate = DecodeState(
             tokens=jnp.asarray(tokens), lengths=jnp.asarray(lengths),
             live=jnp.asarray(live), remaining=jnp.asarray(remaining),

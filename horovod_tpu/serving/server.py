@@ -40,6 +40,28 @@ Wire formats (JSON):
   log-probability under the *unmodified* softmax), ``step`` is the
   serving checkpoint at completion (a hot-reload may land mid-sequence;
   decode continues under the new params, see docs/inference.md).
+
+Request survivability (docs/robustness.md):
+
+* the end-to-end budget arrives as ``X-HVD-TPU-Deadline-Ms`` (the
+  fleet router mints and decrements it; direct clients may set it
+  too) and bounds the request across EVERY stage — unlike
+  ``deadline_ms``, which re-arms per token. A 429 names the stage
+  that shed the request in the ``X-HVD-TPU-Deadline-Exceeded``
+  response header (``queue`` / ``prefill`` / ``decode``);
+* ``POST /v1/generate/stream`` is the journaling transport for
+  mid-stream failover: an NDJSON stream opening with
+  ``{"meta": {"seed", "request_id", "step"}}`` (the *effective* seed,
+  so a resume can pin it), then ``{"t": token, "lp": logprob}`` per
+  token, closing with ``{"done": true, "finish", "step"}`` — or
+  ``{"error", "code", "stage"}`` on an in-stream failure. An EOF
+  without a terminal record means the replica died mid-stream; the
+  router resubmits ``prompt + emitted`` with ``"sample_offset"`` set
+  so the continuation is bit-identical;
+* ``POST /v1/cancel`` ``{"request_id": "..."}`` flags that request's
+  sequences for cancellation (hedging's loser-cancel; resumed-stream
+  cleanup). Cancellation is asynchronous; a cancelled blocking
+  generation answers 499.
 """
 
 import json
@@ -52,8 +74,10 @@ from .. import _http
 from .. import config as _config
 from .. import metrics as _metrics
 from .. import tracing as _tracing
-from .batcher import DeadlineExceededError, QueueFullError
+from .batcher import (DEADLINE_HEADER, DEADLINE_STAGE_HEADER,
+                      DeadlineExceededError, QueueFullError)
 from .engine import InferenceEngine
+from .generation.scheduler import RequestCancelledError
 
 log = logging.getLogger("horovod_tpu.serving")
 
@@ -84,7 +108,8 @@ class _ServingHandler(_http.QuietHandler):
             self._rid = rid
         return rid
 
-    def _respond(self, code: int, doc: dict) -> None:
+    def _respond(self, code: int, doc: dict,
+                 headers: Optional[dict] = None) -> None:
         rid = self._request_id()
         if code >= 400 and "request_id" not in doc:
             # error bodies quote the id too: a client that dropped the
@@ -97,11 +122,28 @@ class _ServingHandler(_http.QuietHandler):
             self.send_header("Content-Type", "application/json")
             self.send_header("Content-Length", str(len(body)))
             self.send_header(REQUEST_ID_HEADER, rid)
+            for k, v in (headers or {}).items():
+                if v is not None:
+                    self.send_header(k, str(v))
             self.end_headers()
             self.wfile.write(body)
         except OSError:
             # client gave up while we were batching; nothing to serve
             self.close_connection = True
+
+    def _deadline_exceeded(self, e: DeadlineExceededError) -> None:
+        """429 with the stage that shed the request named in the
+        ``X-HVD-TPU-Deadline-Exceeded`` header (and body)."""
+        stage = getattr(e, "stage", None)
+        self._respond(429, {"error": str(e), "stage": stage},
+                      headers={DEADLINE_STAGE_HEADER: stage})
+
+    def _budget_ms(self) -> Optional[float]:
+        """Remaining end-to-end budget from ``X-HVD-TPU-Deadline-Ms``
+        (None when absent; a malformed value raises ``ValueError`` into
+        the caller's 400 path)."""
+        raw = self.headers.get(DEADLINE_HEADER)
+        return None if raw is None else float(raw)
 
     def do_GET(self):  # noqa: N802 — BaseHTTPRequestHandler contract
         self._rid = None
@@ -129,6 +171,10 @@ class _ServingHandler(_http.QuietHandler):
             self._infer()
         elif path == "/v1/generate":
             self._generate()
+        elif path == "/v1/generate/stream":
+            self._generate_stream()
+        elif path == "/v1/cancel":
+            self._cancel()
         elif path == "/v1/reload":
             self._reload()
         else:
@@ -172,6 +218,14 @@ class _ServingHandler(_http.QuietHandler):
         try:
             doc = self._read_doc()
             x = np.asarray(doc["inputs"], dtype=np.float32)
+            # the end-to-end budget header tightens (never loosens) the
+            # request's own deadline: the inference plane has a single
+            # dispatch stage, so min() is the whole decrement story here
+            deadline_ms = doc.get("deadline_ms")
+            budget = self._budget_ms()
+            if budget is not None:
+                deadline_ms = (budget if deadline_ms is None
+                               else min(float(deadline_ms), budget))
         except (ValueError, KeyError, TypeError) as e:
             self._respond(400, {"error": f"bad request: {e}"})
             return
@@ -181,12 +235,12 @@ class _ServingHandler(_http.QuietHandler):
                 args={"rows": len(x)}):
             try:
                 out, step = engine.infer_with_step(
-                    x, deadline_ms=doc.get("deadline_ms"))
+                    x, deadline_ms=deadline_ms)
             except QueueFullError as e:
                 self._respond(503, {"error": str(e)})
                 return
             except DeadlineExceededError as e:
-                self._respond(429, {"error": str(e)})
+                self._deadline_exceeded(e)
                 return
             except ValueError as e:         # oversized request, bad rank
                 self._respond(400, {"error": str(e)})
@@ -202,25 +256,38 @@ class _ServingHandler(_http.QuietHandler):
             self._respond(200, {"outputs": np.asarray(out).tolist(),
                                 "step": step})
 
+    def _parse_generate(self, doc: dict) -> dict:
+        """Shared request parsing for ``/v1/generate`` and
+        ``/v1/generate/stream``; ``ValueError``/``KeyError``/
+        ``TypeError`` out of here is the caller's 400."""
+        budget_ms = self._budget_ms()
+        if budget_ms is None and doc.get("budget_ms") is not None:
+            budget_ms = float(doc["budget_ms"])
+
+        def opt(name, conv):
+            v = doc.get(name)
+            return None if v is None else conv(v)
+
+        return dict(
+            prompt=[int(t) for t in doc["prompt"]],
+            max_tokens=int(doc.get("max_tokens", 16)),
+            eos_id=opt("eos_id", int),
+            deadline_ms=doc.get("deadline_ms"),
+            temperature=opt("temperature", float),
+            top_k=opt("top_k", int),
+            top_p=opt("top_p", float),
+            seed=opt("seed", int),
+            budget_ms=budget_ms,
+            sample_offset=int(doc.get("sample_offset", 0)),
+            request_id=self._request_id())
+
     def _generate(self) -> None:
         gen = self.server.gen_engine
         if gen is None:
             self._respond(404, {"error": "no generation engine configured"})
             return
         try:
-            doc = self._read_doc()
-            prompt = [int(t) for t in doc["prompt"]]
-            max_tokens = int(doc.get("max_tokens", 16))
-            eos_id = doc.get("eos_id")
-            eos_id = None if eos_id is None else int(eos_id)
-            temperature = doc.get("temperature")
-            temperature = None if temperature is None else float(temperature)
-            top_k = doc.get("top_k")
-            top_k = None if top_k is None else int(top_k)
-            top_p = doc.get("top_p")
-            top_p = None if top_p is None else float(top_p)
-            seed = doc.get("seed")
-            seed = None if seed is None else int(seed)
+            kwargs = self._parse_generate(self._read_doc())
         except (ValueError, KeyError, TypeError) as e:
             self._respond(400, {"error": f"bad request: {e}"})
             return
@@ -231,20 +298,15 @@ class _ServingHandler(_http.QuietHandler):
         with _tracing.request_span(
                 "server.generate", self._request_id(),
                 parent=self.headers.get(_tracing.TRACE_PARENT_HEADER),
-                args={"prompt_tokens": len(prompt),
-                      "max_tokens": max_tokens}):
+                args={"prompt_tokens": len(kwargs["prompt"]),
+                      "max_tokens": kwargs["max_tokens"]}):
             try:
-                seq = gen.submit(prompt, max_tokens=max_tokens,
-                                 eos_id=eos_id,
-                                 deadline_ms=doc.get("deadline_ms"),
-                                 temperature=temperature, top_k=top_k,
-                                 top_p=top_p, seed=seed,
-                                 request_id=self._request_id())
+                seq = gen.submit(**kwargs)
             except QueueFullError as e:
                 self._respond(503, {"error": str(e)})
                 return
             except DeadlineExceededError as e:
-                self._respond(429, {"error": str(e)})
+                self._deadline_exceeded(e)
                 return
             except ValueError as e:  # could-never-fit, bad sampling params
                 self._respond(400, {"error": str(e)})
@@ -252,7 +314,10 @@ class _ServingHandler(_http.QuietHandler):
             try:
                 tokens = gen.result(seq)
             except DeadlineExceededError as e:
-                self._respond(429, {"error": str(e)})
+                self._deadline_exceeded(e)
+                return
+            except RequestCancelledError as e:
+                self._respond(499, {"error": str(e)})
                 return
             except Exception as e:  # noqa: BLE001 — decode failure -> 500
                 log.warning("serving: generation failed for one sequence "
@@ -263,6 +328,111 @@ class _ServingHandler(_http.QuietHandler):
                                 "logprobs": [round(x, 6)
                                              for x in seq.logprobs],
                                 "step": gen.step})
+
+    def _generate_stream(self) -> None:
+        """NDJSON streaming generation (module docstring: wire format).
+        Admission errors answer as plain JSON statuses; once the meta
+        record is on the wire the stream can only end with a ``done``
+        or ``error`` record — or be severed by this replica dying,
+        which is exactly the EOF the fleet router's failover detects."""
+        gen = self.server.gen_engine
+        if gen is None:
+            self._respond(404, {"error": "no generation engine configured"})
+            return
+        try:
+            kwargs = self._parse_generate(self._read_doc())
+        except (ValueError, KeyError, TypeError) as e:
+            self._respond(400, {"error": f"bad request: {e}"})
+            return
+        rid = self._request_id()
+        with _tracing.request_span(
+                "server.generate_stream", rid,
+                parent=self.headers.get(_tracing.TRACE_PARENT_HEADER),
+                args={"prompt_tokens": len(kwargs["prompt"]),
+                      "max_tokens": kwargs["max_tokens"]}):
+            try:
+                seq = gen.submit(**kwargs)
+            except QueueFullError as e:
+                self._respond(503, {"error": str(e)})
+                return
+            except DeadlineExceededError as e:
+                self._deadline_exceeded(e)
+                return
+            except ValueError as e:
+                self._respond(400, {"error": str(e)})
+                return
+            _M_REQUESTS.labels(code="200").inc()
+            try:
+                self.send_response(200)
+                self.send_header("Content-Type", "application/x-ndjson")
+                self.send_header(REQUEST_ID_HEADER, rid)
+                # no Content-Length: the stream's length is unknown;
+                # EOF semantics carry the severed-stream signal
+                self.send_header("Connection", "close")
+                self.close_connection = True
+                self.end_headers()
+                # the meta record publishes the EFFECTIVE seed (a
+                # seedless submit defaults to the sequence id) — the
+                # one fact a resume cannot reconstruct client-side
+                self._stream_line({"meta": {"seed": seq.seed,
+                                            "request_id": rid,
+                                            "step": gen.step}})
+                n = 0
+                for tok in gen.batcher.stream(seq):
+                    self._stream_line({"t": int(tok),
+                                       "lp": round(seq.logprobs[n], 6)})
+                    n += 1
+                finish = ("eos" if seq.eos_id is not None and seq.generated
+                          and seq.generated[-1] == seq.eos_id else "length")
+                self._stream_line({"done": True, "finish": finish,
+                                   "step": gen.step})
+            except OSError:
+                # the CLIENT went away mid-stream: stop burning decode
+                # capacity on tokens nobody will read
+                gen.cancel(rid)
+            except DeadlineExceededError as e:
+                self._stream_error(e, 429, getattr(e, "stage", None))
+            except RequestCancelledError as e:
+                self._stream_error(e, 499, None)
+            except Exception as e:  # noqa: BLE001 — decode failure
+                log.warning("serving: streamed generation failed "
+                            "(request %s): %s", rid, e)
+                self._stream_error(e, 500, None)
+
+    def _stream_line(self, doc: dict) -> None:
+        self.wfile.write((json.dumps(doc) + "\n").encode("utf-8"))
+        self.wfile.flush()
+
+    def _stream_error(self, err: BaseException, code: int,
+                      stage: Optional[str]) -> None:
+        """Terminal error record for an already-streaming response (the
+        status line is long gone; the record carries the would-be
+        code). Best-effort: the client may already be gone."""
+        try:
+            self._stream_line({"error": str(err), "code": code,
+                               "stage": stage,
+                               "request_id": self._request_id()})
+        except OSError:
+            pass
+
+    def _cancel(self) -> None:
+        """Flag a request id for cancellation on the generation engine
+        (hedging's loser-cancel; resumed-stream cleanup). Always 200:
+        cancellation is asynchronous and idempotent, and an id that
+        matches nothing (already retired, never submitted here) is not
+        an error the caller can act on."""
+        gen = self.server.gen_engine
+        if gen is None:
+            self._respond(404, {"error": "no generation engine configured"})
+            return
+        try:
+            doc = self._read_doc()
+            rid = str(doc["request_id"])
+        except (ValueError, KeyError, TypeError) as e:
+            self._respond(400, {"error": f"bad request: {e}"})
+            return
+        gen.cancel(rid)
+        self._respond(200, {"cancelled": rid})
 
 
 class InferenceServer:

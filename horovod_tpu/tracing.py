@@ -60,11 +60,19 @@ __all__ = ["TraceContext", "Tracer", "Span", "tracer", "reset",
            "request_span", "span", "span_for", "emit_span", "collective",
            "current", "set_current", "sampled", "note_request",
            "last_request_id", "new_request_id", "TRACE_PARENT_HEADER",
-           "KV_SCOPE"]
+           "ATTEMPT_HEADER", "KV_SCOPE"]
 
 #: header carrying the upstream hop's encoded TraceContext so a
 #: replica's server span nests under the router's proxy span
 TRACE_PARENT_HEADER = "X-HVD-TPU-Trace-Parent"
+
+#: attempt ordinal for a request's forwarded tries (0 = first send; a
+#: hedge, connect-error failover, or mid-stream resume increments it).
+#: The router keeps TRACE_PARENT_HEADER and the request id UNCHANGED
+#: across re-submissions and stamps this instead, so every attempt's
+#: spans land in the one trace, numbered, rather than minting
+#: fresh-looking requests
+ATTEMPT_HEADER = "X-HVD-TPU-Attempt"
 
 #: rendezvous KV scope holding each rank's published span list
 KV_SCOPE = "trace"

@@ -1,7 +1,7 @@
 #!/usr/bin/env python
 """Collective-plane microbenchmark driver (VERDICT r3 item 2).
 
-Runs seven sections, each in killable CPU subprocesses, and writes
+Runs eight sections, each in killable CPU subprocesses, and writes
 ``MICROBENCH.json``:
 
 1. ``eager_1proc``  — payload sweep of the eager plane with one process:
@@ -47,10 +47,18 @@ Runs seven sections, each in killable CPU subprocesses, and writes
    collective hook), ``HVD_TPU_TRACE_SAMPLE=0`` vs ``=1``: the off
    delta over a bare loop is the zero-overhead-when-disabled
    acceptance number.
+8. ``failover``     — request-survivability costs (docs/robustness.md):
+   fleet-router hedged-retry tail under a 10%-slow-replica workload
+   (p50/p99 hedging off vs on against latency-scripted HTTP stubs —
+   the p99 collapse is the acceptance number), and the mid-stream
+   failover resume cost at 256 already-emitted tokens (time to the
+   resumed first token, automatic prefix cache on vs off, with the
+   resumed stream asserted bit-identical under seeded sampling).
 
 Usage: ``python microbench.py [--quick]``. Workers are internal
 (``--worker-eager`` / ``--worker-scaling`` / ``--worker-injit`` /
-``--worker-generation`` / ``--worker-sdc`` / ``--worker-tracing``).
+``--worker-generation`` / ``--worker-sdc`` / ``--worker-tracing`` /
+``--worker-failover``).
 """
 
 import json
@@ -277,6 +285,34 @@ def _run_tracing(quick: bool, timeout: int):
     return rows[0] if rows else None
 
 
+def worker_failover(quick: bool) -> int:
+    from horovod_tpu.microbench import hedging_sweep, resume_sweep
+    row = hedging_sweep(requests=40 if quick else 80)
+    print(MB_TAG + json.dumps(row))
+    row = resume_sweep(emitted=96 if quick else 256)
+    print(MB_TAG + json.dumps(row))
+    return 0
+
+
+def _run_failover(quick: bool, timeout: int):
+    """Returns [hedging_sweep, resume_sweep] rows (or None)."""
+    cmd = [sys.executable, os.path.abspath(__file__), "--worker-failover"]
+    if quick:
+        cmd.append("--quick")
+    try:
+        p = subprocess.run(cmd, env=_cpu_env(), text=True,
+                           capture_output=True, timeout=timeout)
+    except subprocess.TimeoutExpired:
+        _log("failover: timeout")
+        return None
+    sys.stderr.write(p.stderr or "")
+    if p.returncode != 0:
+        _log(f"failover: rc={p.returncode}")
+        return None
+    rows = _collect(p.stdout or "")
+    return rows or None
+
+
 def _run_injit(n: int, quick: bool, timeout: int):
     env = _cpu_env({
         "XLA_FLAGS": f"--xla_force_host_platform_device_count={n}",
@@ -315,6 +351,8 @@ def main():
             return worker_sdc(quick)
         if a == "--worker-tracing":
             return worker_tracing(quick)
+        if a == "--worker-failover":
+            return worker_failover(quick)
 
     t0 = time.time()
     result = {"quick": quick}
@@ -326,15 +364,15 @@ def main():
         bk = next((r for r in rows if "scenario" in r), None)
         return plain, bk
 
-    _log("section 1/7: eager sweep, 1 process")
+    _log("section 1/8: eager sweep, 1 process")
     result["eager_1proc"], result["bucketed_1proc"] = split_bucketed(
         _run_eager(1, quick, timeout=600))
 
-    _log("section 2/7: eager sweep, 2 processes")
+    _log("section 2/8: eager sweep, 2 processes")
     result["eager_2proc"], result["bucketed_2proc"] = split_bucketed(
         _run_eager(2, quick, timeout=900))
 
-    _log("section 3/7: compiled-plane scaling sweep")
+    _log("section 3/8: compiled-plane scaling sweep")
     points = []
     for n in (1, 2, 4, 8):
         row = _run_scaling(n, quick, timeout=600)
@@ -349,7 +387,7 @@ def main():
                 / (p["num_devices"] * base["images_per_sec_total"]), 3)
     result["scaling"] = points
 
-    _log("section 4/7: in-jit fast path (ResNet-50 gradient scenario)")
+    _log("section 4/8: in-jit fast path (ResNet-50 gradient scenario)")
     injit_rows = []
     for n in ((1, 2) if quick else (1, 2, 8)):
         row = _run_injit(n, quick, timeout=900)
@@ -371,7 +409,7 @@ def main():
                  f"(x{row['packed_speedup_vs_per_leaf']} vs per-leaf)")
     result["injit"] = injit_rows
 
-    _log("section 5/7: continuous vs static batch generation + sampling")
+    _log("section 5/8: continuous vs static batch generation + sampling")
     gen_rows = _run_generation(quick, timeout=1200)
     gen = gen_rows[0] if gen_rows else None
     sampling = gen_rows[1] if gen_rows and len(gen_rows) > 1 else None
@@ -397,7 +435,7 @@ def main():
     result["generation_sampling"] = sampling
     result["generation_prefix"] = prefix
 
-    _log("section 6/7: SDC guard + fingerprint overhead")
+    _log("section 6/8: SDC guard + fingerprint overhead")
     sdc = _run_sdc(quick, timeout=600)
     if sdc:
         _log(f"  guard on/off: {sdc['guarded_ms_per_step']} vs "
@@ -408,7 +446,7 @@ def main():
              f"{sdc['fingerprint_every']} steps")
     result["sdc"] = sdc
 
-    _log("section 7/7: per-request tracing overhead")
+    _log("section 7/8: per-request tracing overhead")
     tracing_row = _run_tracing(quick, timeout=300)
     if tracing_row:
         _log(f"  off {tracing_row['off_us_per_req']} us/req over bare "
@@ -417,6 +455,25 @@ def main():
              f"on {tracing_row['on_us_per_req']} us/req "
              f"(+{tracing_row['on_overhead_us_per_req']} us traced)")
     result["tracing"] = tracing_row
+
+    _log("section 8/8: request survivability (hedging tail + resume cost)")
+    fo_rows = _run_failover(quick, timeout=900)
+    hedging = fo_rows[0] if fo_rows else None
+    resume = fo_rows[1] if fo_rows and len(fo_rows) > 1 else None
+    if hedging:
+        _log(f"  hedging: p99 {hedging['off']['p99_ms']} ms off -> "
+             f"{hedging['on']['p99_ms']} ms on "
+             f"(x{hedging['p99_speedup']}, "
+             f"{hedging['on']['hedges_launched']} launched / "
+             f"{hedging['on']['hedges_won']} won)")
+    if resume:
+        _log(f"  resume at {resume['emitted_tokens']} tokens: "
+             f"{resume['resume_first_token_ms_cache_on']} ms cached vs "
+             f"{resume['resume_first_token_ms_cache_off']} ms cold "
+             f"(x{resume['cached_resume_speedup']}, bit_identical="
+             f"{resume['bit_identical']})")
+    result["failover"] = ({"hedging": hedging, "resume": resume}
+                          if fo_rows else None)
     result["wall_s"] = round(time.time() - t0, 1)
 
     out_path = os.path.join(ROOT, "MICROBENCH.json")
@@ -463,6 +520,10 @@ def main():
         ["off_overhead_us_per_req"] if tracing_row else None,
         "tracing_on_overhead_us_per_req": tracing_row
         ["on_overhead_us_per_req"] if tracing_row else None,
+        "hedging_p99_speedup": hedging["p99_speedup"] if hedging else None,
+        "resume_first_token_ms_cached": resume
+        ["resume_first_token_ms_cache_on"] if resume else None,
+        "resume_bit_identical": resume["bit_identical"] if resume else None,
     }))
     return 0
 

@@ -187,6 +187,24 @@ def test_fleet_suite_is_seeded_and_exclusive():
     assert os.path.exists(os.path.join(root, "tests", "test_fleet.py"))
 
 
+def test_fleet_failover_suite_is_seeded_and_exclusive():
+    """The request-survivability suite (end-to-end deadline stages,
+    EDF-within-tenant, hedged retries under retry budgets, and the
+    mid-stream fleet.stream failover drill with its bit-identity
+    proof) runs seeded as its own CI suite; the generic unit and chaos
+    suites must not run the file twice."""
+    by_name = {name: cmd for name, cmd, _t in COMMON_SUITES}
+    assert "chaos-fleet-failover" in by_name
+    cmd = by_name["chaos-fleet-failover"]
+    assert "HVD_TPU_FAULT_SEED=" in cmd
+    assert "tests/test_failover.py" in cmd
+    assert "--ignore=tests/test_failover.py" in by_name["unit"]
+    assert "--ignore=tests/test_failover.py" in by_name["chaos"]
+    assert "tests/test_failover.py" not in by_name["serving-fleet"]
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    assert os.path.exists(os.path.join(root, "tests", "test_failover.py"))
+
+
 def test_generation_suite_is_seeded_and_exclusive():
     """The continuous-batching generation suite (paged KV cache,
     decode parity, preemption, prefill/decode/evict chaos drills, the

@@ -1,0 +1,824 @@
+"""Request-survivability suite (ISSUE 17): end-to-end deadline
+propagation with stage attribution, hedged retries under per-tenant
+retry budgets, and mid-stream generation failover.
+
+Runs as its own seeded CI suite (``chaos-fleet-failover`` in
+ci/gen_pipeline.py, owns this file exclusively). The headline drill:
+kill a replica at token N of a seeded streamed generation and assert
+the client receives the full bit-identical token sequence — zero
+duplicates, zero missing tokens, zero client-visible errors — with
+``hvd_tpu_fleet_failovers_total{outcome="resumed"}`` incremented.
+"""
+
+import json
+import socket
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.error import HTTPError
+from urllib.request import Request, urlopen
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from horovod_tpu import faults as F
+from horovod_tpu import metrics as M
+from horovod_tpu import serving
+from horovod_tpu import tracing
+from horovod_tpu.models.transformer import Transformer, TransformerConfig
+from horovod_tpu.serving import fleet
+from horovod_tpu.serving.batcher import (DEADLINE_HEADER,
+                                         DEADLINE_STAGE_HEADER,
+                                         DeadlineExceededError)
+from horovod_tpu.serving.fleet import rollout as fleet_rollout
+from horovod_tpu.serving.fleet.tenancy import (FairScheduler,
+                                               NoCapacityError, RetryBudget,
+                                               Tenant)
+from horovod_tpu.serving.generation import GenerationEngine
+from horovod_tpu.serving.generation.scheduler import RequestCancelledError
+
+SEED = 1234
+
+IN_DIM, OUT_DIM = 4, 2
+
+CFG = TransformerConfig(vocab_size=64, num_layers=2, d_model=32,
+                        num_heads=2, head_dim=16, max_seq_len=96,
+                        dtype=jnp.float32)
+
+#: non-greedy sampling restrictive enough to exercise top-k AND top-p —
+#: the hard case for resumed-continuation bit-identity
+SAMPLED = dict(temperature=0.9, top_k=12, top_p=0.85)
+
+PROMPT = [3, 11, 42, 7, 19, 5]
+
+
+@pytest.fixture(autouse=True)
+def _reset_faults():
+    yield
+    F.configure("", seed=0)
+
+
+@pytest.fixture(scope="module")
+def model_params():
+    model = Transformer(CFG)
+    params = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 4), jnp.int32))
+    return model, params
+
+
+def _apply(params, x):
+    return x @ params["w"] + params["b"]
+
+
+def _params(scale: float):
+    return {"w": np.full((IN_DIM, OUT_DIM), scale, np.float32),
+            "b": np.zeros(OUT_DIM, np.float32)}
+
+
+def _gen_engine(model, params, **kw):
+    kw.setdefault("block_size", 4)
+    kw.setdefault("num_blocks", 49)
+    kw.setdefault("max_seqs", 4)
+    kw.setdefault("prefill_chunk", 8)
+    kw.setdefault("deadline_ms", 0)
+    return GenerationEngine(model, params=params, **kw)
+
+
+def _gen_replica(model, params, **kw):
+    srv = serving.InferenceServer(None, port=0, addr="127.0.0.1",
+                                  gen_engine=_gen_engine(model, params,
+                                                         **kw))
+    srv.start()
+    return srv
+
+
+def _infer_replica(apply_fn=_apply, **kw):
+    kw.setdefault("max_batch", 8)
+    kw.setdefault("batch_timeout_ms", 2.0)
+    kw.setdefault("deadline_ms", 0)
+    kw.setdefault("reload_poll_seconds", 0)
+    kw.setdefault("warmup", False)
+    eng = serving.InferenceEngine(apply_fn, params=_params(1.0), **kw)
+    srv = serving.InferenceServer(eng, port=0, addr="127.0.0.1")
+    srv.start()
+    return srv
+
+
+def _router(replicas, **kw):
+    kw.setdefault("addr", "127.0.0.1")
+    kw.setdefault("heartbeat_timeout", 0.5)
+    kw.setdefault("heartbeat_interval", 0.1)
+    r = fleet.FleetRouter(replicas, port=0, **kw)
+    r.start()
+    return r
+
+
+def _post(url, doc, headers=None, timeout=30):
+    req = Request(url, data=json.dumps(doc).encode(), method="POST",
+                  headers={"Content-Type": "application/json",
+                           **(headers or {})})
+    try:
+        with urlopen(req, timeout=timeout) as resp:
+            return resp.status, json.loads(resp.read()), dict(resp.headers)
+    except HTTPError as e:
+        return e.code, json.loads(e.read() or b"{}"), dict(e.headers)
+
+
+def _stream(url, doc, headers=None, timeout=120):
+    """POST a streaming generation and collect every NDJSON record."""
+    req = Request(url, data=json.dumps(doc).encode(), method="POST",
+                  headers={"Content-Type": "application/json",
+                           **(headers or {})})
+    with urlopen(req, timeout=timeout) as resp:
+        return [json.loads(line) for line in resp if line.strip()]
+
+
+def _tokens(records):
+    return [r["t"] for r in records if "t" in r]
+
+
+def _delta(before, key):
+    return M.snapshot().get(key, 0) - before.get(key, 0)
+
+
+def _dead_port():
+    """A 127.0.0.1 port that refuses connections."""
+    probe = socket.socket()
+    probe.bind(("127.0.0.1", 0))
+    port = probe.getsockname()[1]
+    probe.close()
+    return port
+
+
+# ---------------------------------------------------------------------------
+# end-to-end deadline: four stages, un-meetable requests shed immediately
+# ---------------------------------------------------------------------------
+
+class TestDeadlineStages:
+    def test_route_stage_rejects_spent_budget_at_router(self, model_params):
+        model, params = model_params
+        srv = _gen_replica(model, params)
+        router = _router({"r0": f"http://127.0.0.1:{srv.port}"})
+        try:
+            code, doc, headers = _post(
+                router.url + "/v1/generate",
+                {"prompt": PROMPT, "max_tokens": 4},
+                headers={DEADLINE_HEADER: "0"})
+            assert code == 429
+            assert headers.get(DEADLINE_STAGE_HEADER) == "route"
+            assert doc.get("stage") == "route"
+        finally:
+            router.stop()
+            srv.close()
+
+    def test_queue_stage_rejected_at_admission_no_prefill_chunk(
+            self, model_params):
+        """An un-meetable budget is shed at admission — stage ``queue``
+        — without consuming a single prefill chunk."""
+        model, params = model_params
+        phases = []
+        with _gen_engine(model, params,
+                         on_step=lambda ph, ids: phases.append(
+                             (ph, list(ids)))) as eng:
+            before = M.snapshot()
+            with pytest.raises(DeadlineExceededError) as ei:
+                eng.submit(PROMPT, max_tokens=4, budget_ms=-5,
+                           request_id="req-spent")
+            assert ei.value.stage == "queue"
+            assert _delta(
+                before,
+                'hvd_tpu_serving_deadline_stage_total{stage="queue"}') == 1
+            # give the scheduler a beat: no prefill work may appear
+            time.sleep(0.1)
+            assert all(ph != "prefill" for ph, _ in phases), phases
+
+    def test_queue_stage_sheds_waiting_sequence(self, model_params):
+        """A queued-but-unadmitted sequence whose budget dies waits in
+        line and sheds with stage ``queue`` — its id never reaches a
+        prefill step."""
+        model, params = model_params
+        phases = []
+        slow = lambda ph, ids: (phases.append((ph, list(ids))),
+                                time.sleep(0.05))[0]
+        with _gen_engine(model, params, max_seqs=1, on_step=slow) as eng:
+            hog = eng.submit(PROMPT, max_tokens=30)
+            late = eng.submit(list(reversed(PROMPT)), max_tokens=4,
+                              budget_ms=80)
+            with pytest.raises(DeadlineExceededError) as ei:
+                eng.result(late, timeout=60)
+            assert ei.value.stage == "queue"
+            assert all(late.id not in ids for ph, ids in phases
+                       if ph == "prefill")
+            eng.result(hog, timeout=120)
+
+    def test_prefill_stage(self, model_params):
+        model, params = model_params
+        slow_prefill = lambda ph, ids: time.sleep(
+            0.08 if ph == "prefill" else 0)
+        with _gen_engine(model, params, prefill_chunk=4,
+                         on_step=slow_prefill) as eng:
+            before = M.snapshot()
+            seq = eng.submit(list(range(1, 41)), max_tokens=4,
+                             budget_ms=150)
+            with pytest.raises(DeadlineExceededError) as ei:
+                eng.result(seq, timeout=60)
+            assert ei.value.stage == "prefill"
+            assert _delta(
+                before,
+                'hvd_tpu_serving_deadline_stage_total{stage="prefill"}') == 1
+
+    def test_decode_stage(self, model_params):
+        model, params = model_params
+        slow_decode = lambda ph, ids: time.sleep(
+            0.06 if ph == "decode" else 0)
+        with _gen_engine(model, params, on_step=slow_decode) as eng:
+            before = M.snapshot()
+            seq = eng.submit(PROMPT, max_tokens=60, budget_ms=700)
+            with pytest.raises(DeadlineExceededError) as ei:
+                eng.result(seq, timeout=60)
+            assert ei.value.stage == "decode"
+            assert len(seq.generated) > 0, "budget must die mid-decode"
+            assert _delta(
+                before,
+                'hvd_tpu_serving_deadline_stage_total{stage="decode"}') == 1
+
+    def test_server_names_stage_in_429_header(self, model_params):
+        model, params = model_params
+        srv = _gen_replica(model, params)
+        try:
+            code, doc, headers = _post(
+                f"http://127.0.0.1:{srv.port}/v1/generate",
+                {"prompt": PROMPT, "max_tokens": 4},
+                headers={DEADLINE_HEADER: "-5"})
+            assert code == 429
+            assert headers.get(DEADLINE_STAGE_HEADER) == "queue"
+        finally:
+            srv.close()
+
+
+class TestEDFWithinTenant:
+    def test_near_deadline_dequeues_first_within_one_tenant(self):
+        cap = {"v": 0}
+        sched = FairScheduler(capacity_fn=lambda: cap["v"])
+        t = Tenant("t", max_concurrent=16, max_queued=16)
+        order, lock = [], threading.Lock()
+
+        def one(tag, deadline_ts):
+            sched.acquire(t, deadline_ts=deadline_ts)
+            with lock:
+                order.append(tag)
+            sched.release(t)
+
+        now = time.monotonic()
+        jobs = [("far", now + 30), ("near", now + 8), ("mid", now + 15),
+                ("none", None)]
+        threads = []
+        for tag, dl in jobs:
+            th = threading.Thread(target=one, args=(tag, dl), daemon=True)
+            th.start()
+            threads.append(th)
+            # deterministic arrival order (FIFO is the EDF tie-break)
+            deadline = time.monotonic() + 5
+            while sched.stats().get("t", {}).get("queued", 0) \
+                    < len(threads):
+                assert time.monotonic() < deadline
+                time.sleep(0.005)
+        cap["v"] = 1
+        sched.kick()
+        for th in threads:
+            th.join(timeout=30)
+            assert not th.is_alive()
+        assert order == ["near", "mid", "far", "none"], order
+        sched.close()
+
+
+# ---------------------------------------------------------------------------
+# zero-capacity queue flush (satellite fix)
+# ---------------------------------------------------------------------------
+
+class TestZeroCapacityFlush:
+    def test_flush_fails_queued_waiters_fast(self):
+        sched = FairScheduler(capacity_fn=lambda: 0)
+        t = Tenant("t", max_queued=8)
+        errors = []
+
+        def one():
+            try:
+                sched.acquire(t, deadline_ts=time.monotonic() + 30)
+            except BaseException as e:  # noqa: BLE001
+                errors.append(e)
+
+        threads = [threading.Thread(target=one, daemon=True)
+                   for _ in range(3)]
+        for th in threads:
+            th.start()
+        deadline = time.monotonic() + 5
+        while sched.stats().get("t", {}).get("queued", 0) < 3:
+            assert time.monotonic() < deadline
+            time.sleep(0.01)
+        t0 = time.monotonic()
+        sched.flush_no_capacity()
+        for th in threads:
+            th.join(timeout=5)
+            assert not th.is_alive()
+        assert time.monotonic() - t0 < 2.0, "flush must not wait deadlines"
+        assert len(errors) == 3
+        assert all(isinstance(e, NoCapacityError) for e in errors)
+        assert sched.stats().get("t", {}).get("queued", 1) == 0
+        sched.close()
+
+    def test_last_replica_ejected_flushes_router_queue(self, monkeypatch):
+        """Regression (ISSUE 17 satellite): a request queued behind the
+        fleet's only concurrency slot gets a fast 503 the moment the
+        last replica is ejected — not a wait until its own deadline."""
+        monkeypatch.setenv("HVD_TPU_FLEET_REPLICA_CONCURRENCY", "1")
+
+        def slow_apply(params, x):
+            time.sleep(3.0)
+            return _apply(params, x)
+
+        srv = _infer_replica(slow_apply)
+        router = _router({"r0": f"http://127.0.0.1:{srv.port}"})
+        hb = fleet.ReplicaHeartbeat(router.url, "r0", interval=0.1)
+        results = {}
+
+        def hog():
+            results["hog"] = _post(router.url + "/v1/infer",
+                                   {"inputs": [[1.0] * IN_DIM]})
+
+        def queued():
+            t0 = time.monotonic()
+            code, doc, _ = _post(router.url + "/v1/infer",
+                                 {"inputs": [[1.0] * IN_DIM]},
+                                 headers={DEADLINE_HEADER: "30000"})
+            results["queued"] = (code, doc, time.monotonic() - t0)
+
+        try:
+            hb.start()
+            time.sleep(0.3)     # armed
+            th_hog = threading.Thread(target=hog, daemon=True)
+            th_hog.start()
+            time.sleep(0.3)     # hog occupies the only slot
+            th_q = threading.Thread(target=queued, daemon=True)
+            th_q.start()
+            time.sleep(0.3)     # queued behind the slot
+            hb.stop()
+            srv.stop()          # replica dead: beats AND server gone
+            th_q.join(timeout=10)
+            assert not th_q.is_alive(), "queued request must be flushed"
+            code, doc, elapsed = results["queued"]
+            assert code == 503, results["queued"]
+            assert elapsed < 2.5, \
+                f"flush must beat the 30s deadline (took {elapsed:.1f}s)"
+            th_hog.join(timeout=10)
+        finally:
+            hb.stop()
+            router.stop()
+            srv.close()
+
+
+# ---------------------------------------------------------------------------
+# scheduler-level resume bit-identity (sample_offset)
+# ---------------------------------------------------------------------------
+
+class TestSampleOffsetResume:
+    @pytest.mark.parametrize("sampling", [{}, SAMPLED],
+                             ids=["greedy", "sampled"])
+    def test_split_generation_is_bit_identical(self, model_params,
+                                               sampling):
+        """The resume contract under everything else: generating N
+        tokens, then submitting ``prompt + first_k`` with the SAME seed
+        and ``sample_offset=k``, reproduces the uninterrupted sequence
+        exactly."""
+        model, params = model_params
+        n, k, seed = 24, 9, 7
+        with _gen_engine(model, params) as eng:
+            full = eng.result(eng.submit(PROMPT, max_tokens=n, seed=seed,
+                                         **sampling), timeout=240)
+            head = eng.result(eng.submit(PROMPT, max_tokens=k, seed=seed,
+                                         **sampling), timeout=240)
+            assert head == full[:k]
+            tail = eng.result(
+                eng.submit(PROMPT + head, max_tokens=n - k, seed=seed,
+                           sample_offset=k, **sampling), timeout=240)
+        assert head + tail == full
+
+
+# ---------------------------------------------------------------------------
+# streaming endpoint + cancel (server-direct)
+# ---------------------------------------------------------------------------
+
+class TestStreamEndpoint:
+    def test_stream_matches_blocking_generate(self, model_params):
+        model, params = model_params
+        srv = _gen_replica(model, params)
+        try:
+            doc = {"prompt": PROMPT, "max_tokens": 12, "seed": 5,
+                   **SAMPLED}
+            url = f"http://127.0.0.1:{srv.port}"
+            code, blocking, _ = _post(url + "/v1/generate", doc)
+            assert code == 200
+            records = _stream(url + "/v1/generate/stream", doc)
+            meta = records[0]["meta"]
+            assert meta["seed"] == 5
+            assert meta["request_id"]
+            assert "step" in meta
+            assert _tokens(records) == blocking["tokens"]
+            assert [round(r["lp"], 6) for r in records if "t" in r] \
+                == blocking["logprobs"]
+            assert records[-1]["done"] is True
+            assert records[-1]["finish"] in ("eos", "length")
+        finally:
+            srv.close()
+
+    def test_cancel_terminates_stream_with_499(self, model_params):
+        model, params = model_params
+        srv = _gen_replica(
+            model, params,
+            on_step=lambda ph, ids: time.sleep(
+                0.05 if ph == "decode" else 0))
+        try:
+            url = f"http://127.0.0.1:{srv.port}"
+            req = Request(url + "/v1/generate/stream",
+                          data=json.dumps({"prompt": PROMPT,
+                                           "max_tokens": 80}).encode(),
+                          method="POST",
+                          headers={"Content-Type": "application/json"})
+            with urlopen(req, timeout=60) as resp:
+                meta = json.loads(resp.readline())["meta"]
+                rid = meta["request_id"]
+                # a couple of real tokens, then pull the plug
+                for _ in range(2):
+                    assert "t" in json.loads(resp.readline())
+                code, doc, _ = _post(url + "/v1/cancel",
+                                     {"request_id": rid})
+                assert code == 200 and doc["cancelled"] == rid
+                terminal = None
+                deadline = time.monotonic() + 30
+                while time.monotonic() < deadline:
+                    line = resp.readline()
+                    if not line:
+                        break
+                    rec = json.loads(line)
+                    if "t" not in rec:
+                        terminal = rec
+                        break
+            assert terminal is not None, "cancel must terminate the stream"
+            assert terminal.get("code") == 499, terminal
+        finally:
+            srv.close()
+
+
+# ---------------------------------------------------------------------------
+# the headline drill: mid-stream failover, bit-identical to uninterrupted
+# ---------------------------------------------------------------------------
+
+class TestMidStreamFailover:
+    @pytest.mark.parametrize("sampling", [{}, SAMPLED],
+                             ids=["greedy", "sampled"])
+    def test_injected_kill_resumes_bit_identical(self, model_params,
+                                                 sampling):
+        """Kill the stream at token N via the seeded ``fleet.stream``
+        site: the client still receives the FULL token sequence, equal
+        to the uninterrupted baseline, with zero client-visible errors
+        and exactly one resumed failover."""
+        model, params = model_params
+        r0 = _gen_replica(model, params)
+        r1 = _gen_replica(model, params)
+        router = _router({"r0": f"http://127.0.0.1:{r0.port}",
+                          "r1": f"http://127.0.0.1:{r1.port}"})
+        try:
+            doc = {"prompt": PROMPT, "max_tokens": 24, "seed": 7,
+                   **sampling}
+            url = router.url + "/v1/generate/stream"
+            baseline = _stream(url, doc)
+            assert baseline[-1].get("done") is True
+            base_tokens = _tokens(baseline)
+            assert len(base_tokens) == 24
+
+            before = M.snapshot()
+            F.configure("fleet.stream:error:after=8:times=1", seed=SEED)
+            drill = _stream(url, doc)
+            F.configure("", seed=0)
+
+            assert _tokens(drill) == base_tokens, \
+                "resumed stream must be bit-identical (no dupes/missing)"
+            assert [r for r in drill if "error" in r] == []
+            assert drill[-1].get("done") is True
+            assert drill[0]["meta"]["seed"] == 7
+            assert _delta(
+                before,
+                'hvd_tpu_fleet_failovers_total{outcome="resumed"}') == 1
+        finally:
+            router.stop()
+            r0.close(), r1.close()
+
+    def test_real_replica_death_mid_stream_resumes(self, model_params):
+        """Not a simulation: the serving replica's process state is torn
+        down mid-stream (server stopped, engine closed) and the client
+        still gets the complete, baseline-identical sequence."""
+        model, params = model_params
+        slow = lambda ph, ids: time.sleep(0.03 if ph == "decode" else 0)
+        r1 = _gen_replica(model, params)
+        doc = {"prompt": PROMPT, "max_tokens": 24, "seed": 11, **SAMPLED}
+        # baseline from the survivor, uninterrupted
+        baseline = _stream(f"http://127.0.0.1:{r1.port}"
+                           "/v1/generate/stream", doc)
+        base_tokens = _tokens(baseline)
+        r0 = _gen_replica(model, params, on_step=slow)
+        router = _router({"r0": f"http://127.0.0.1:{r0.port}",
+                          "r1": f"http://127.0.0.1:{r1.port}"})
+        try:
+            before = M.snapshot()
+            req = Request(router.url + "/v1/generate/stream",
+                          data=json.dumps(doc).encode(), method="POST",
+                          headers={"Content-Type": "application/json"})
+            records = []
+            with urlopen(req, timeout=120) as resp:
+                # r0 (id tie-break) serves; take a few tokens, then
+                # kill it for real
+                while len(_tokens(records)) < 3:
+                    records.append(json.loads(resp.readline()))
+                r0.close()
+                for line in resp:
+                    if line.strip():
+                        records.append(json.loads(line))
+            assert _tokens(records) == base_tokens
+            assert [r for r in records if "error" in r] == []
+            assert records[-1].get("done") is True
+            assert _delta(
+                before,
+                'hvd_tpu_fleet_failovers_total{outcome="resumed"}') == 1
+        finally:
+            router.stop()
+            r1.close()
+
+    def test_takeover_without_survivor_counts_failed(self, model_params):
+        model, params = model_params
+        r0 = _gen_replica(
+            model, params,
+            on_step=lambda ph, ids: time.sleep(
+                0.03 if ph == "decode" else 0))
+        router = _router({"r0": f"http://127.0.0.1:{r0.port}"})
+        try:
+            before = M.snapshot()
+            req = Request(router.url + "/v1/generate/stream",
+                          data=json.dumps({"prompt": PROMPT,
+                                           "max_tokens": 40}).encode(),
+                          method="POST",
+                          headers={"Content-Type": "application/json"})
+            records = []
+            with urlopen(req, timeout=60) as resp:
+                while len(_tokens(records)) < 2:
+                    records.append(json.loads(resp.readline()))
+                r0.close()
+                for line in resp:
+                    if line.strip():
+                        records.append(json.loads(line))
+            errors = [r for r in records if "error" in r]
+            assert errors, "no survivor: the client must see the failure"
+            assert _delta(
+                before,
+                'hvd_tpu_fleet_failovers_total{outcome="failed"}') == 1
+        finally:
+            router.stop()
+
+
+# ---------------------------------------------------------------------------
+# hedged retries + retry budget
+# ---------------------------------------------------------------------------
+
+class TestHedging:
+    def test_hedge_beats_slow_replica(self, model_params, monkeypatch):
+        """With hedging armed, a request stuck on the slow replica is
+        re-issued to the fast one after the latency quantile; the
+        client sees the fast answer, far sooner than the slow replica
+        would have delivered."""
+        monkeypatch.setenv("HVD_TPU_FLEET_HEDGE_QUANTILE", "0.9")
+        model, params = model_params
+        # ~0.1s per request: long enough that the first concurrent
+        # request still HOLDS the fast replica when the second picks
+        fast = _gen_replica(
+            model, params,
+            on_step=lambda ph, ids: time.sleep(
+                0.01 if ph == "decode" else 0))
+        # ~0.2s per decoded token: a 10-token generation takes >= 2s
+        slow = _gen_replica(
+            model, params,
+            on_step=lambda ph, ids: time.sleep(
+                0.2 if ph == "decode" else 0))
+        # "f-..." < "s-...": sequential warmup ties resolve to fast
+        router = _router({"f-fast": f"http://127.0.0.1:{fast.port}",
+                          "s-slow": f"http://127.0.0.1:{slow.port}"})
+        doc = {"prompt": PROMPT, "max_tokens": 10, "seed": 3}
+        try:
+            for srv in (fast, slow):
+                # compile the decode programs off the clock: the hedge
+                # delay is a latency quantile and a one-off compile
+                # outlier in the sample would swamp it
+                code, _, _ = _post(
+                    f"http://127.0.0.1:{srv.port}/v1/generate",
+                    {"prompt": PROMPT, "max_tokens": 1})
+                assert code == 200
+            for _ in range(9):     # warm the hedge-delay latency sample
+                code, _, _ = _post(router.url + "/v1/generate", doc)
+                assert code == 200
+            before = M.snapshot()
+            results = {}
+
+            def client(tag):
+                t0 = time.monotonic()
+                code, _, _ = _post(router.url + "/v1/generate", doc)
+                results[tag] = (code, time.monotonic() - t0)
+
+            # two concurrent requests: the second lands on the slow
+            # replica (fast already has the first outstanding)
+            a = threading.Thread(target=client, args=("a",), daemon=True)
+            a.start()
+            time.sleep(0.02)
+            b = threading.Thread(target=client, args=("b",), daemon=True)
+            b.start()
+            a.join(timeout=60), b.join(timeout=60)
+            assert results["a"][0] == 200 and results["b"][0] == 200
+            assert max(results["a"][1], results["b"][1]) < 1.6, \
+                f"hedge must beat the >=2s slow replica: {results}"
+            assert _delta(
+                before,
+                'hvd_tpu_fleet_hedges_total{outcome="launched"}') >= 1
+            assert _delta(
+                before, 'hvd_tpu_fleet_hedges_total{outcome="won"}') >= 1
+        finally:
+            router.stop()
+            fast.close(), slow.close()
+
+
+class TestRetryBudget:
+    def test_bucket_accrual_and_spend(self, monkeypatch):
+        monkeypatch.setenv("HVD_TPU_FLEET_RETRY_BUDGET_RATIO", "0.5")
+        monkeypatch.setenv("HVD_TPU_FLEET_RETRY_BUDGET_BURST", "2")
+        b = RetryBudget()
+        assert b.try_spend("t") and b.try_spend("t")    # burst pre-fill
+        assert not b.try_spend("t")
+        b.note_request("t")
+        assert not b.try_spend("t")                     # 0.5 < 1 token
+        b.note_request("t")
+        assert b.try_spend("t")
+
+    def test_flood_collapses_to_pass_through(self, monkeypatch):
+        """Against a fully-dead fleet, retries stop the moment the
+        budget drains: granted tokens are bounded by the burst while
+        every further failure passes straight through as its own 503 —
+        no retry storm."""
+        monkeypatch.setenv("HVD_TPU_FLEET_RETRY_BUDGET_RATIO", "0")
+        monkeypatch.setenv("HVD_TPU_FLEET_RETRY_BUDGET_BURST", "2")
+        router = _router({"r0": f"http://127.0.0.1:{_dead_port()}",
+                          "r1": f"http://127.0.0.1:{_dead_port()}"})
+        try:
+            before = M.snapshot()
+            codes = []
+            for _ in range(6):
+                code, _, _ = _post(router.url + "/v1/infer",
+                                   {"inputs": [[1.0] * IN_DIM]},
+                                   timeout=10)
+                codes.append(code)
+            assert codes == [503] * 6, codes
+            granted = _delta(
+                before,
+                'hvd_tpu_fleet_retry_budget_total'
+                '{tenant="default",outcome="granted"}')
+            denied = _delta(
+                before,
+                'hvd_tpu_fleet_retry_budget_total'
+                '{tenant="default",outcome="denied"}')
+            assert granted <= 2, f"retries must be bounded by the burst " \
+                f"(granted={granted})"
+            assert denied >= 1, "exhausted budget must deny, not retry"
+        finally:
+            router.stop()
+
+
+# ---------------------------------------------------------------------------
+# attempt / trace header propagation (satellite)
+# ---------------------------------------------------------------------------
+
+class _CaptureReplica(BaseHTTPRequestHandler):
+    captured = []
+
+    def do_POST(self):  # noqa: N802
+        length = int(self.headers.get("Content-Length", 0))
+        self.rfile.read(length)
+        # urllib re-capitalizes header names on the wire: store a
+        # case-insensitive view (the real handler's self.headers is one)
+        type(self).captured.append(
+            {k.lower(): v for k, v in self.headers.items()})
+        body = json.dumps({"tokens": [5], "logprobs": [0.0],
+                           "step": 0}).encode()
+        self.send_response(200)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def log_message(self, *a):
+        pass
+
+
+class TestAttemptHeaders:
+    def test_failover_keeps_identity_and_numbers_the_attempt(
+            self, monkeypatch):
+        """A connect-error failover re-submission carries the SAME
+        request id and trace parent, a decremented deadline budget, and
+        ``X-HVD-TPU-Attempt: 1`` instead of minting a fresh request."""
+        monkeypatch.setenv("HVD_TPU_TRACE_SAMPLE", "1.0")
+        tracing.reset()
+        _CaptureReplica.captured = []
+        live = ThreadingHTTPServer(("127.0.0.1", 0), _CaptureReplica)
+        threading.Thread(target=live.serve_forever, daemon=True).start()
+        # "a-dead" < "b-live": the dead replica is always tried first
+        router = _router(
+            {"a-dead": f"http://127.0.0.1:{_dead_port()}",
+             "b-live": f"http://127.0.0.1:{live.server_address[1]}"})
+        try:
+            code, _, headers = _post(
+                router.url + "/v1/generate",
+                {"prompt": [1, 2], "max_tokens": 1},
+                headers={fleet.REQUEST_ID_HEADER: "req-survive",
+                         DEADLINE_HEADER: "20000"})
+            assert code == 200
+            assert headers.get(fleet.REQUEST_ID_HEADER) == "req-survive"
+            assert len(_CaptureReplica.captured) == 1
+            seen = _CaptureReplica.captured[0]
+            assert seen.get(tracing.ATTEMPT_HEADER.lower()) == "1"
+            assert seen.get(fleet.REQUEST_ID_HEADER.lower()) \
+                == "req-survive"
+            parent = seen.get(tracing.TRACE_PARENT_HEADER.lower())
+            assert parent, "trace parent must survive the failover"
+            assert tracing.TraceContext.decode(parent).trace_id \
+                == "req-survive"
+            left = float(seen.get(DEADLINE_HEADER.lower()))
+            assert 0 < left < 20000, "budget must be decremented, not reset"
+        finally:
+            router.stop()
+            live.shutdown()
+            live.server_close()
+            tracing.reset()
+
+
+# ---------------------------------------------------------------------------
+# rolling reload vs long-lived streams (satellite)
+# ---------------------------------------------------------------------------
+
+class TestRollingReloadWithStream:
+    def test_drain_bounded_by_stream_budget(self, model_params,
+                                            monkeypatch):
+        """A stream that outlives the drain deadline holds the replica
+        only until its own end-to-end budget sheds it — the reload then
+        completes instead of aborting (and instead of waiting forever)."""
+        model, params = model_params
+        slow = lambda ph, ids: time.sleep(0.05 if ph == "decode" else 0)
+        r0 = _gen_replica(model, params, on_step=slow)
+        r1 = _gen_replica(model, params, on_step=slow)
+        router = _router({"r0": f"http://127.0.0.1:{r0.port}",
+                          "r1": f"http://127.0.0.1:{r1.port}"})
+        monkeypatch.setattr(fleet_rollout, "_post_reload",
+                            lambda url, step, timeout: {"reloaded": True,
+                                                        "step": step})
+        monkeypatch.setattr(fleet_rollout, "_verify_healthy",
+                            lambda url, step, timeout: None)
+        records = []
+
+        def stream_client():
+            try:
+                records.extend(_stream(
+                    router.url + "/v1/generate/stream",
+                    {"prompt": PROMPT, "max_tokens": 80},
+                    headers={DEADLINE_HEADER: "2500"}))
+            except Exception as e:  # noqa: BLE001
+                records.append({"client_error": str(e)})
+
+        try:
+            th = threading.Thread(target=stream_client, daemon=True)
+            th.start()
+            deadline = time.monotonic() + 10
+            while router.outstanding("r0") == 0:
+                assert time.monotonic() < deadline
+                time.sleep(0.02)
+            t0 = time.monotonic()
+            summary = fleet_rollout.rolling_reload(
+                router, drain_deadline=0.3, poll=0.02)
+            elapsed = time.monotonic() - t0
+            assert summary["result"] == "ok"
+            # the drain outlived its 0.3s bound (the stream held it)
+            # but terminated at the stream's ~2.5s budget
+            assert elapsed < 15, f"drain must terminate ({elapsed:.1f}s)"
+            th.join(timeout=30)
+            assert not th.is_alive()
+            # the stream ended via its budget: an in-band 429, decode
+            # stage — not a hang, not a severed connection
+            terminal = [r for r in records if "error" in r]
+            assert terminal and terminal[-1]["code"] == 429, records[-3:]
+        finally:
+            router.stop()
+            r0.close(), r1.close()
