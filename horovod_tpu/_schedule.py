@@ -391,32 +391,62 @@ def _env_rank() -> int:
         return 0
 
 
+def _fp_key(rank: int, group: Optional[int] = None) -> str:
+    """KV key for one rank's fingerprint. Flat (``sdc.fp.rank<r>``) for
+    the legacy whole-world compare; ``sdc.fp.g<g>.rank<r>`` when scoped
+    to a replica group, so sharded meshes key by (replica_group, rank)
+    and a tp/fsdp shard-holder can never read a *different* shard's
+    fingerprint as its peer's."""
+    if group is None:
+        return f"sdc.fp.rank{rank}"
+    return f"sdc.fp.g{int(group)}.rank{rank}"
+
+
 def publish_sdc_fingerprint(step: int, fp: int,
-                            rank: Optional[int] = None) -> int:
+                            rank: Optional[int] = None,
+                            group: Optional[int] = None,
+                            leaf_fps: Optional[Dict[int, int]] = None
+                            ) -> int:
     """Best-effort PUT of this rank's parameter fingerprint to the
-    ``schedule`` scope (key ``sdc.fp.rank<r>``). Returns the rank used,
-    so the caller can tell whether a named divergence is its own."""
+    ``schedule`` scope (key :func:`_fp_key`). Returns the rank used,
+    so the caller can tell whether a named divergence is its own.
+    ``leaf_fps`` (leaf index -> per-leaf checksum) rides along when
+    provided, so a divergence can name the offending leaf too."""
     if rank is None:
         rank = _env_rank()
     client = _sdc_kv_client()
     if client is not None:
+        payload = {"step": int(step), "fp": int(fp), "rank": int(rank)}
+        if group is not None:
+            payload["group"] = int(group)
+        if leaf_fps:
+            payload["leaves"] = {str(i): int(v)
+                                 for i, v in leaf_fps.items()}
         try:
-            client.put("schedule", f"sdc.fp.rank{rank}",
-                       json.dumps({"step": int(step), "fp": int(fp),
-                                   "rank": int(rank)}).encode())
+            client.put("schedule", _fp_key(rank, group),
+                       json.dumps(payload).encode())
         except Exception:
             pass
     return rank
 
 
-def fetch_sdc_fingerprints(world_size: int) -> Dict[int, dict]:
+def fetch_sdc_fingerprints(world_size: Optional[int] = None,
+                           group: Optional[int] = None,
+                           ranks: Optional[List[int]] = None
+                           ) -> Dict[int, dict]:
+    """Fingerprint payloads by rank. ``ranks`` restricts the fetch to a
+    replica group's members (with ``group`` selecting the scoped keys);
+    otherwise every rank in ``range(world_size)`` is polled on the flat
+    keys — the legacy pure-dp behavior."""
     client = _sdc_kv_client()
     if client is None:
         return {}
+    if ranks is None:
+        ranks = list(range(int(world_size or 0)))
     out: Dict[int, dict] = {}
-    for r in range(world_size):
+    for r in ranks:
         try:
-            raw = client.get("schedule", f"sdc.fp.rank{r}")
+            raw = client.get("schedule", _fp_key(r, group))
         except Exception:
             raw = None
         if raw:
@@ -428,12 +458,15 @@ def fetch_sdc_fingerprints(world_size: int) -> Dict[int, dict]:
 
 
 def diff_sdc_fingerprints(peers: Dict[int, dict],
-                          step: Optional[int] = None
+                          step: Optional[int] = None,
+                          group: Optional[int] = None
                           ) -> Optional[Tuple[List[int], str]]:
     """Name the diverging rank(s) among published fingerprints, majority
     vote: ``(diverging_ranks, one-line diagnostic)`` or None when the
     replicas agree. Only entries for ``step`` are compared (peers mid-
-    publish at an older step must not read as divergence)."""
+    publish at an older step must not read as divergence). ``group``
+    scopes the diagnostic to a replica group; when the payloads carry
+    per-leaf checksums the diverging leaf indices are named too."""
     at_step = {r: p for r, p in peers.items()
                if isinstance(p, dict) and "fp" in p
                and (step is None or p.get("step") == step)}
@@ -452,8 +485,36 @@ def diff_sdc_fingerprints(peers: Dict[int, dict],
     diverging = sorted(r for fp, ranks in by_fp.items()
                        if fp != majority_fp for r in ranks)
     at = f" at step {step}" if step is not None else ""
-    return diverging, (
+    msg = (
         f"parameter fingerprint divergence{at}: rank(s) "
         f"{', '.join(map(str, diverging))} disagree with the majority "
         f"fingerprint 0x{majority_fp:08x} held by "
         f"{len(by_fp[majority_fp])} rank(s)")
+    if group is not None:
+        msg += f" within replica group {group}"
+    leaves = _diverging_leaves(at_step, by_fp[majority_fp], diverging)
+    if leaves:
+        msg += f"; diverging leaf index(es): {', '.join(map(str, leaves))}"
+    return diverging, msg
+
+
+def _diverging_leaves(at_step: Dict[int, dict], majority_ranks: List[int],
+                      diverging: List[int]) -> List[int]:
+    """Leaf indices whose per-leaf checksums differ between the lowest
+    majority rank and any diverging rank (empty when payloads carry no
+    per-leaf data — the legacy publisher)."""
+    ref = at_step.get(min(majority_ranks), {}).get("leaves")
+    if not isinstance(ref, dict):
+        return []
+    bad = set()
+    for r in diverging:
+        theirs = at_step.get(r, {}).get("leaves")
+        if not isinstance(theirs, dict):
+            continue
+        for key in set(ref) | set(theirs):
+            if ref.get(key) != theirs.get(key):
+                try:
+                    bad.add(int(key))
+                except (TypeError, ValueError):
+                    pass
+    return sorted(bad)

@@ -316,7 +316,13 @@ class CheckpointManager:
         committed) for ``step`` is drained, never duplicated: the
         in-flight copy lands via ``wait_until_finished`` and the stale
         check then sees it committed. Returns the newest committed step
-        (None when the directory holds none)."""
+        (None when the directory holds none).
+
+        Under sharded (fsdp) training this is the *shard handoff* of a
+        graceful drain: the departing host persists its own parameter
+        shards here, and the surviving mesh's restore plan reassembles
+        them from the checkpoint by recorded global offsets — peers are
+        never asked to serve shards they do not hold."""
         self.wait_until_finished()
         if step is not None and tree is not None:
             latest = self.latest_step()

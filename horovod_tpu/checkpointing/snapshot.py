@@ -118,9 +118,19 @@ def _snapshot_array_leaf(index: int, path: str, leaf) -> LeafSnapshot:
             shards.append(HostShard(
                 _shard_starts(shard.index, leaf.ndim),
                 np.array(jax.device_get(shard.data))))
+        # A fully-addressable jax.Array (single process, or sharded over
+        # a purely host-local mesh) has no cross-process ownership: every
+        # process that holds one holds it in full, exactly like a plain
+        # numpy leaf — so the multihost rank-0 write convention applies.
+        # Without this, N eager-dp processes each checkpointing their
+        # bit-identical local-mesh replica would merge N overlapping
+        # shard sets into one manifest and the restore-side coverage
+        # check would (rightly) refuse it. Partially-addressable arrays
+        # keep real per-process ownership via replica_id filtering.
         return LeafSnapshot(index, path, ARRAY, dtype=str(leaf.dtype),
                             shape=tuple(leaf.shape), shards=shards,
-                            local=False)
+                            local=bool(getattr(leaf, "is_fully_addressable",
+                                               False)))
     arr = np.array(leaf)    # copy: the caller may mutate after save()
     return LeafSnapshot(index, path, ARRAY, dtype=str(arr.dtype),
                         shape=tuple(arr.shape),

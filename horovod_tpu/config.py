@@ -324,6 +324,26 @@ ELASTIC_SCALE_DOWN_POLICY = _register(
          "restore its shards via resharding, host stays re-admittable — "
          "while 'immediate' fires the legacy kill path (host event -> "
          "worker exit -> FAILURE -> blacklist).")
+MESH_SHAPE = _register(
+    "MESH_SHAPE", "", str,
+    help="Process-level parallelism mesh the elastic driver plans over, "
+         "as an 'axis=size' comma list over (dp, fsdp, pp, ep, sp, tp) — "
+         "e.g. 'dp=2,fsdp=2', or 'dp=-1,fsdp=2' to absorb the first "
+         "generation's world size into dp. Empty (default) disables the "
+         "driver's mesh plane: membership changes replan only the flat "
+         "world size. When set, every generation the driver recomputes "
+         "the mesh from the survivor count (MESH_RESHAPE_POLICY) and "
+         "publishes it to the journaled 'mesh' rendezvous scope for "
+         "workers to adopt on reset.")
+MESH_RESHAPE_POLICY = _register(
+    "MESH_RESHAPE_POLICY", "shrink", str,
+    help="How the elastic driver re-forms the mesh when the survivor "
+         "count changes: 'shrink' (default) shrinks dp first, then fsdp, "
+         "never the inner pp/ep/sp/tp axes, and raises MeshShapeError "
+         "when survivors don't divide into whole inner groups; 'degrade' "
+         "additionally drops a remainder (whole dp replica groups' worth "
+         "of capacity idles) instead of aborting; 'strict' refuses any "
+         "shape change (a lost host fails the job).")
 
 # -- Consistency checking (replaces the reference controller's per-cycle
 #    dtype/shape validation, controller.cc:378-611) --------------------------

@@ -31,7 +31,7 @@ User API (mirrors ``hvd.elastic``)::
 """
 
 from .state import State, ObjectState, JaxState  # noqa: F401
-from .run import run, run_fn  # noqa: F401
+from .run import fetch_mesh_shape, run, run_fn  # noqa: F401
 from .discovery import (  # noqa: F401
     HostDiscovery, HostDiscoveryScript, FixedHosts, HostManager,
     DiscoveredHosts,

@@ -9,6 +9,7 @@ TPU: every reset the workers rebuild the JAX distributed runtime and the
 device mesh; the driver only manages host membership.
 """
 
+import json
 import logging
 import queue
 import threading
@@ -33,6 +34,14 @@ from .worker import PUT_WORKER_ADDRESSES, WorkerNotificationClient
 #: their durable record lives in the ``preempt`` scope instead, and is
 #: deleted when the drain completes.
 BLACKLIST_SCOPE = "blacklist"
+
+#: rendezvous scope carrying the driver's current mesh plan (key
+#: ``shape`` -> JSON ``{"axes": {...}, "policy": ..., "dropped": N}``).
+#: Journaled like the blacklist, so a restarted coordinator resumes the
+#: reshaped mesh instead of replanning from the configured one; workers
+#: read it on reset (:func:`horovod_tpu.elastic.run.fetch_mesh_shape`)
+#: to re-form the survivor mesh.
+MESH_SCOPE = "mesh"
 
 # Elastic membership events as counters: a flapping host shows up as a
 # climbing add/remove rate on the driver's scrape, which no single worker
@@ -62,6 +71,14 @@ _M_SCALE_EVENTS = _metrics.counter(
     "Deliberate elastic resizes, by direction: 'up' (debounced growth "
     "into new capacity), 'down' (preemption-notice shrink).",
     labels=("direction",))
+_M_MESH_RESHAPES = _metrics.counter(
+    "hvd_tpu_elastic_mesh_reshapes_total",
+    "Mesh replans taken by the elastic driver's mesh plane "
+    "(HVD_TPU_MESH_SHAPE set), by the reshape policy that produced the "
+    "new shape ('shrink'/'degrade'/'strict') and the capacity direction "
+    "('down' after host loss or drain, 'up' after re-admission). "
+    "Launcher-side, like the reset counters.",
+    labels=("policy", "direction"))
 _M_QUARANTINED = _metrics.gauge(
     "hvd_tpu_sdc_quarantined_hosts",
     "Hosts quarantined for silent data corruption (blacklisted with "
@@ -153,6 +170,24 @@ class ElasticDriver:
             cfg.get(_config.ELASTIC_SCALE_UP_DELAY))
         self._scale_down_policy = str(
             cfg.get(_config.ELASTIC_SCALE_DOWN_POLICY)).strip().lower()
+        # Mesh plane: when HVD_TPU_MESH_SHAPE names a parallelism grid,
+        # every generation replans it from the survivor count
+        # (HVD_TPU_MESH_RESHAPE_POLICY) and publishes the result to the
+        # journaled 'mesh' scope for workers to adopt on reset.
+        self._mesh_policy = str(
+            cfg.get(_config.MESH_RESHAPE_POLICY)).strip().lower()
+        self._mesh_config = None
+        self._mesh_error: Optional[str] = None
+        mesh_spec = str(cfg.get(_config.MESH_SHAPE) or "").strip()
+        if mesh_spec:
+            from ..parallel import mesh_utils
+            self._mesh_config = mesh_utils.mesh_config_from_spec(mesh_spec)
+        #: host -> blacklist reason ("failure"/"sdc"/...; "drained" for
+        #: graceful departures). Rebuilt from the journaled blacklist
+        #: scope on coordinator restart, so re-admission decisions (an
+        #: SDC-quarantined host must stay out of a reshaped mesh) never
+        #: lose their reason.
+        self._blacklist_reasons: Dict[str, str] = {}
         #: host -> {"grace": s, "ts": notice unix time, "start": monotonic}
         #: for in-flight graceful drains (host also flagged in HostManager)
         self._draining: Dict[str, dict] = {}
@@ -275,14 +310,22 @@ class ElasticDriver:
           discovery reports it again.
         """
         if reason == "drained":
+            self._blacklist_reasons.setdefault(host, reason)
             self._host_manager.mark_draining(host)
             return
+        self._blacklist_reasons[host] = reason
         self._host_manager.blacklist(host)
         try:
             self._rendezvous.put(BLACKLIST_SCOPE, host, reason.encode())
         except Exception:
             log.debug("elastic: could not persist blacklist entry for %s",
                       host, exc_info=True)
+
+    def blacklist_reason(self, host: str) -> Optional[str]:
+        """Why ``host`` was excluded (``failure``/``sdc``/``drained``),
+        or None if it never was. Survives coordinator restarts via the
+        journaled blacklist scope (:meth:`restore_from_rendezvous`)."""
+        return self._blacklist_reasons.get(host)
 
     def record_preemption_notice(self, host: str, grace: float = 0.0,
                                  ts: Optional[float] = None,
@@ -408,18 +451,48 @@ class ElasticDriver:
 
     def restore_from_rendezvous(self) -> int:
         """Re-seed driver state from a journal-restored KV store: worker
-        notification addresses, the blacklist, and in-flight preemption
-        drains. Called by the launcher after ``attach_elastic_handlers``
-        when the rendezvous came back from disk (coordinator hot-restart
-        path); a fresh store holds nothing and this is a no-op. Returns
-        the number of re-seeded entries."""
+        notification addresses, the blacklist *with reasons*, in-flight
+        preemption drains, and the mesh plan. Called by the launcher
+        after ``attach_elastic_handlers`` when the rendezvous came back
+        from disk (coordinator hot-restart path); a fresh store holds
+        nothing and this is a no-op. Returns the number of re-seeded
+        entries.
+
+        Reasons matter across a restart that also changes the mesh: an
+        SDC-quarantined host must stay quarantined (not degrade to a
+        generic failure that a later operator unblacklist would
+        re-admit into the reshaped mesh), so the blacklist scope's
+        *values* are decoded, not just its keys."""
         import pickle
 
         count = 0
-        for host in self._rendezvous.items(BLACKLIST_SCOPE):
+        for host, blob in self._rendezvous.items(BLACKLIST_SCOPE).items():
+            try:
+                reason = bytes(blob).decode().strip() or "failure"
+            except Exception:
+                reason = "failure"
+            self._blacklist_reasons.setdefault(host, reason)
+            if reason == "sdc" and host not in self._quarantined:
+                self._quarantined.add(host)
+                _M_QUARANTINED.set(len(self._quarantined))
             if not self._host_manager.is_blacklisted(host):
                 self._host_manager.blacklist(host)
                 count += 1
+        # The mesh plan survives with the blacklist: the restarted
+        # coordinator must resume the *reshaped* mesh, not replan from
+        # the configured shape as if nothing had been lost.
+        mesh_blob = self._rendezvous.items(MESH_SCOPE).get("shape")
+        if mesh_blob:
+            try:
+                from ..parallel import mesh_utils
+                axes = json.loads(bytes(mesh_blob).decode()).get("axes", {})
+                self._mesh_config = mesh_utils.MeshConfig(**{
+                    a: int(v) for a, v in axes.items()
+                    if a in mesh_utils.AXIS_ORDER})
+                count += 1
+            except Exception:
+                log.warning("elastic: stale mesh-shape entry not restored",
+                            exc_info=True)
         # Drains survive a coordinator restart: the preempt scope is
         # journaled, so a notice recorded before the crash keeps its host
         # out of the restarted coordinator's first generation too.
@@ -453,6 +526,66 @@ class ElasticDriver:
                         "from the restored rendezvous", count,
                         "y" if count == 1 else "ies")
         return count
+
+    # -- mesh plane ----------------------------------------------------------
+    def mesh_shape(self) -> Optional[Dict[str, int]]:
+        """The driver's current mesh plan as axis -> size (None when the
+        mesh plane is off, i.e. HVD_TPU_MESH_SHAPE unset)."""
+        if self._mesh_config is None:
+            return None
+        from ..parallel.mesh_utils import AXIS_ORDER
+        return {a: int(getattr(self._mesh_config, a)) for a in AXIS_ORDER}
+
+    def mesh_error(self) -> Optional[str]:
+        """The last mesh replan failure (MeshShapeError text), cleared by
+        the next successful replan. The generation still forms at the old
+        shape — a refused replan must be visible, not fatal to the
+        control plane."""
+        return self._mesh_error
+
+    def _replan_mesh(self, world: int) -> None:
+        """Recompute the mesh from the new generation's world size and
+        publish it to the journaled ``mesh`` scope. On MeshShapeError
+        (survivors don't divide, or policy 'strict' refuses) the old plan
+        is kept and the error recorded — the flat-world generation still
+        forms, and the operator sees exactly which policy refused which
+        counts instead of a pjit shape error."""
+        if self._mesh_config is None:
+            return
+        from ..parallel import mesh_utils
+        try:
+            plan = mesh_utils.plan_reshape(self._mesh_config, world,
+                                           policy=self._mesh_policy)
+        except mesh_utils.MeshShapeError as e:
+            self._mesh_error = str(e)
+            log.error("elastic: mesh replan for world size %d failed: %s "
+                      "— keeping the previous mesh plan", world, e)
+            return
+        self._mesh_error = None
+        if plan.direction != "none":
+            _M_MESH_RESHAPES.labels(policy=plan.policy,
+                                    direction=plan.direction).inc()
+            log.warning(
+                "elastic: mesh reshaped %s for %d survivor(s): now %s "
+                "(policy=%s%s)", plan.direction, world,
+                {a: getattr(plan.config, a)
+                 for a in mesh_utils.AXIS_ORDER},
+                plan.policy,
+                f", {plan.dropped} survivor(s) idle" if plan.dropped
+                else "")
+        self._mesh_config = plan.config
+        payload = {
+            "axes": {a: int(getattr(plan.config, a))
+                     for a in mesh_utils.AXIS_ORDER},
+            "policy": plan.policy,
+            "dropped": int(plan.dropped),
+        }
+        try:
+            self._rendezvous.put(MESH_SCOPE, "shape",
+                                 json.dumps(payload).encode())
+        except Exception:
+            log.debug("elastic: could not publish mesh shape",
+                      exc_info=True)
 
     # -- assignment queries --------------------------------------------------
     def world_size(self) -> int:
@@ -665,6 +798,10 @@ class ElasticDriver:
                 if host not in by_host:
                     self._complete_drain(host)
         self._world_size = len(assignment_list)
+        # Mesh replan BEFORE the rendezvous init: a worker whose blocking
+        # rank_and_size GET returns must already be able to read the new
+        # generation's mesh shape.
+        self._replan_mesh(self._world_size)
         # The generation being formed already reflects current membership;
         # a pending host-change notice would only re-interrupt it.
         self._pending_notice_ts = None

@@ -103,6 +103,40 @@ def requery_assignment() -> bool:
     return True
 
 
+def fetch_mesh_shape() -> "dict | None":
+    """The driver's published mesh plan (axis -> size), or None.
+
+    Workers call this after :func:`requery_assignment` (or at startup)
+    to learn the mesh the new generation should re-form — the driver's
+    :meth:`ElasticDriver._replan_mesh` publishes it to the journaled
+    ``mesh`` scope *before* the blocking rank_and_size GET returns, so
+    a worker that has its new rank can always read the matching shape.
+    None outside elastic launches, when the mesh plane is off
+    (``HVD_TPU_MESH_SHAPE`` unset), or on any fetch failure — callers
+    fall back to their local mesh construction.
+    """
+    client = _rendezvous_client(timeout=5.0)
+    if client is None:
+        return None
+    try:
+        blob = client.get("mesh", "shape")
+    except Exception:
+        return None
+    if not blob:
+        return None
+    import json
+    try:
+        axes = json.loads(blob.decode()).get("axes")
+    except (ValueError, UnicodeDecodeError):
+        return None
+    if not isinstance(axes, dict):
+        return None
+    try:
+        return {str(a): int(v) for a, v in axes.items()}
+    except (TypeError, ValueError):
+        return None
+
+
 def _persist_state(state) -> None:
     """Write the committed snapshot next to the env for the exec'd self."""
     saved = getattr(state, "_saved_state", None)

@@ -35,7 +35,11 @@ Grammar — ``;``-separated entries, each ``site:field[:field...]``:
   - ``rate=P``     fire with probability P per hit (default 1.0);
   - ``after=N``    ignore the first N hits of the point;
   - ``step=N``     fire exactly on hit N (1-based) — e.g. crash on the
-                   12th ``worker.step`` (one hit per ``State.commit()``);
+                   12th ``worker.step`` (one hit per ``State.commit()``),
+                   or ``worker.mesh:crash:step=N:rank=R`` to hard-kill
+                   rank R mid-sharded-step (one hit per
+                   ``parallel.train.run_mesh_step``) — the mesh-aware
+                   recovery drill (docs/elastic.md);
   - ``times=N`` / ``once``  cap total injections for the rule;
   - ``rank=R``     only inject on the process whose rank is R.
 

@@ -15,9 +15,21 @@ program over a (dp, fsdp, pp, ep, sp, tp) mesh where
 """
 
 import dataclasses
-from typing import Optional
+from typing import Any, Dict, Optional
 
 import numpy as np
+
+from .. import faults as _faults
+
+# Chaos site for the sharded train step: one hit per run_mesh_step()
+# call, fired BEFORE the jitted step executes — a ``crash`` rule here
+# (``worker.mesh:crash:step=N:rank=R``) hard-kills a rank mid-sharded-
+# step, the deterministic stand-in for losing a host out of a
+# dp x fsdp x tp mesh. The work of the killed step is lost on every
+# rank exactly as a real host loss would lose it; survivors re-form the
+# reshaped mesh and restore the last sharded checkpoint through the
+# resharding reader (docs/elastic.md, mesh-aware recovery).
+_FP_MESH = _faults.FaultPoint("worker.mesh")
 
 
 def sharded_attention(mesh, kind: str = "ring", causal: bool = True):
@@ -113,3 +125,68 @@ def make_transformer_train_step(cfg, mesh, optimizer=None,
     step = jax.jit(_step, donate_argnums=(0, 1))
     return TrainStepBundle(step=step, params=params, opt_state=opt_state,
                            batch_sharding=batch_sharding, mesh=mesh)
+
+
+# -- mesh-aware recovery: run / save / restore / drain the sharded train
+#    state (docs/elastic.md). These are the pieces the elastic drill
+#    composes: the fault site above kills a rank mid-step, the driver
+#    replans the mesh, and the survivor generation restores step-exact
+#    through the resharding checkpoint reader.
+
+
+def train_state_tree(bundle: TrainStepBundle) -> Dict[str, Any]:
+    """The checkpointable pytree of a :class:`TrainStepBundle` — exactly
+    the state a surviving mesh must restore to resume step-exact."""
+    return {"params": bundle.params, "opt_state": bundle.opt_state}
+
+
+def run_mesh_step(bundle: TrainStepBundle, tokens, targets):
+    """One optimizer step through the bundle (fires the ``worker.mesh``
+    chaos site first); updates the bundle in place, returns the loss."""
+    _FP_MESH.fire()
+    params, opt_state, loss = bundle.step(bundle.params, bundle.opt_state,
+                                          tokens, targets)
+    bundle.params = params
+    bundle.opt_state = opt_state
+    return loss
+
+
+def save_mesh_train_state(manager, step: int, bundle: TrainStepBundle,
+                          async_: bool = False) -> str:
+    """Checkpoint the bundle's train state at ``step``. Sharded leaves
+    are written shard-by-shard with their global offsets recorded, so a
+    later restore can reassemble them onto a *different* mesh."""
+    return manager.save(step, train_state_tree(bundle), async_=async_,
+                        force=True)
+
+
+def restore_mesh_train_state(manager, bundle: TrainStepBundle,
+                             step: Optional[int] = None) -> Optional[int]:
+    """Restore the newest (or ``step``'s) checkpoint into the bundle,
+    re-staged onto the bundle's *current* shardings — the save-mesh and
+    the restore-mesh are independent (checkpointing/snapshot.py records
+    global offsets per shard). Returns the restored step, or None when
+    the directory holds no checkpoint (fresh start)."""
+    import jax
+
+    target_step = manager.latest_step() if step is None else step
+    if target_step is None:
+        return None
+    target = train_state_tree(bundle)
+    shardings = jax.tree_util.tree_map(
+        lambda leaf: getattr(leaf, "sharding", None), target)
+    tree = manager.restore(step=target_step, target=target,
+                           sharding=shardings, fallback=True)
+    bundle.params = tree["params"]
+    bundle.opt_state = tree["opt_state"]
+    return target_step
+
+
+def drain_mesh_train_state(manager, step: int,
+                           bundle: TrainStepBundle) -> Optional[int]:
+    """Preemption-drain the bundle: flush in-flight saves and force a
+    final sync save of this host's shards if the newest committed step
+    is older — the shard handoff of a graceful departure. The restore
+    plan of the surviving mesh covers the departed host's fsdp shards
+    from this checkpoint, never from peers that never held them."""
+    return manager.drain_for_preemption(step, train_state_tree(bundle))

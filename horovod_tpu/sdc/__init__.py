@@ -17,7 +17,7 @@ package is the loud-making layer (docs/robustness.md, SDC section):
 """
 
 from .fingerprint import (FingerprintMonitor, fingerprint_diverged,  # noqa: F401
-                          fold_fingerprint)
+                          fold_fingerprint, fold_leaf_fingerprints)
 from .guard import (Detection, StepGuard, corrupt_grads,  # noqa: F401
                     guard_update)
 from .policy import ROLLBACK, SKIP, SdcPolicy  # noqa: F401
@@ -26,6 +26,7 @@ from .report import SDC_SCOPE, decode_report, encode_report  # noqa: F401
 __all__ = [
     "Detection", "StepGuard", "corrupt_grads", "guard_update",
     "FingerprintMonitor", "fingerprint_diverged", "fold_fingerprint",
+    "fold_leaf_fingerprints",
     "SdcPolicy", "SKIP", "ROLLBACK",
     "SDC_SCOPE", "encode_report", "decode_report",
 ]

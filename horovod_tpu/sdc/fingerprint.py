@@ -16,15 +16,25 @@ detection for the rollback/quarantine policy.
 """
 
 import logging
-from typing import Optional
+from typing import Dict, List, Optional
 
 import numpy as np
 
 from .. import _schedule
 from .. import config as _config
+from .. import metrics as _metrics
 from .guard import _M_DETECTIONS, Detection
 
 log = logging.getLogger("horovod_tpu.sdc")
+
+_M_FP_DIVERGENCE = _metrics.counter(
+    "hvd_tpu_sdc_fingerprint_divergence_total",
+    "Cross-replica parameter fingerprint divergences, by the replica "
+    "group they were detected in ('all' for the legacy whole-world "
+    "compare of pure-dp runs). On a sharded (dp x fsdp x tp) mesh each "
+    "group compares only ranks holding bit-identical replicas — a tick "
+    "here is a real divergence, never two different shards compared.",
+    labels=("replica_group",))
 
 #: FNV-1a constants — the fold must be cheap, deterministic, and
 #: sensitive to any single flipped bit (a plain value sum is not: two
@@ -51,6 +61,26 @@ def fold_fingerprint(tree) -> int:
     return int(acc)
 
 
+def fold_leaf_fingerprints(tree) -> Dict[int, int]:
+    """Per-leaf uint32 checksums, keyed by pytree leaf index — the same
+    FNV-style fold as :func:`fold_fingerprint` but not chained across
+    leaves, so a divergence can name the corrupted leaf. Non-inexact and
+    empty leaves are skipped (matching the scalar fold)."""
+    import jax
+
+    out: Dict[int, int] = {}
+    with np.errstate(over="ignore"):
+        for i, leaf in enumerate(jax.tree_util.tree_leaves(tree)):
+            a = np.asarray(leaf)
+            if not np.issubdtype(a.dtype, np.inexact) or a.size == 0:
+                continue
+            bits = np.ascontiguousarray(a.astype(np.float32)).view(np.uint32)
+            s = np.uint32(np.sum(bits, dtype=np.uint64) & 0xFFFFFFFF)
+            out[i] = int(np.uint32((_FNV_OFFSET ^ s) * _FNV_PRIME
+                                   + np.uint32(i)))
+    return out
+
+
 def fingerprint_diverged(fp, axis_name: str):
     """Jit-compatible divergence flag: True when replicas along
     ``axis_name`` disagree on the fingerprint scalar ``fp``."""
@@ -70,26 +100,61 @@ class FingerprintMonitor:
     fingerprint). On a mismatch it returns a :class:`Detection` of kind
     ``fingerprint`` whose ``local`` flag says whether THIS rank is in
     the diverging minority (the one the quarantine policy charges).
+
+    **Replica-group scoping.** On a sharded (dp x fsdp x tp) mesh only
+    ranks along the dp axis hold bit-identical parameters; comparing
+    across fsdp/tp shard-holders would false-trip on every check. Pass
+    ``replica_group``/``group_ranks`` (or build via :meth:`for_mesh`) to
+    fold per-leaf fingerprints and compare them *only* across the ranks
+    of this rank's replica group, published under keys scoped by
+    ``(replica_group, rank)``.
     """
 
-    def __init__(self, every: Optional[int] = None):
+    def __init__(self, every: Optional[int] = None,
+                 replica_group: Optional[int] = None,
+                 group_ranks: Optional[List[int]] = None):
         self.every = int(_config.live_config().get(
             _config.SDC_FINGERPRINT_EVERY)) if every is None else int(every)
+        self.replica_group = replica_group
+        self.group_ranks = list(group_ranks) if group_ranks else None
+
+    @classmethod
+    def for_mesh(cls, world_size: int, rank: int, dp: int,
+                 every: Optional[int] = None) -> "FingerprintMonitor":
+        """Monitor scoped to ``rank``'s replica group on a mesh with
+        ``dp`` data-parallel replicas over ``world_size`` ranks."""
+        from ..parallel import mesh_utils
+        group = mesh_utils.replica_group_of(rank, world_size, dp)
+        ranks = mesh_utils.replica_groups(world_size, dp)[group]
+        return cls(every=every, replica_group=group, group_ranks=ranks)
 
     def maybe_check(self, step: int, params) -> Optional[Detection]:
         if self.every <= 0 or step % self.every != 0:
             return None
         fp = fold_fingerprint(params)
-        rank = _schedule.publish_sdc_fingerprint(step, fp)
-        size = _world_size()
-        if size < 2:
-            return None
-        peers = _schedule.fetch_sdc_fingerprints(size)
-        diverged = _schedule.diff_sdc_fingerprints(peers, step)
+        scoped = self.group_ranks is not None
+        leaf_fps = fold_leaf_fingerprints(params) if scoped else None
+        rank = _schedule.publish_sdc_fingerprint(
+            step, fp, group=self.replica_group, leaf_fps=leaf_fps)
+        if scoped:
+            if len(self.group_ranks) < 2:
+                return None   # lone shard-holder: publish-only
+            peers = _schedule.fetch_sdc_fingerprints(
+                group=self.replica_group, ranks=self.group_ranks)
+        else:
+            size = _world_size()
+            if size < 2:
+                return None
+            peers = _schedule.fetch_sdc_fingerprints(size)
+        diverged = _schedule.diff_sdc_fingerprints(
+            peers, step, group=self.replica_group)
         if diverged is None:
             return None
         ranks, msg = diverged
         _M_DETECTIONS.labels(kind="fingerprint").inc()
+        _M_FP_DIVERGENCE.labels(
+            replica_group=str(self.replica_group)
+            if scoped else "all").inc()
         log.warning("sdc: %s", msg)
         return Detection(kind="fingerprint", local=rank in ranks)
 

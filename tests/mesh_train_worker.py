@@ -1,0 +1,159 @@
+"""Worker for the seeded 2-process mesh-aware elastic recovery drill
+(tests/test_mesh_elastic.py).
+
+Each process is one host of the driver's process-level parallelism grid
+(``HVD_TPU_MESH_SHAPE``, e.g. ``dp=2``) and builds its OWN local device
+mesh (``MESH_TEST_LOCAL_SHAPE``, e.g. ``fsdp=2`` over forced CPU
+devices) — the in-process analogue of losing one host out of a
+dp x fsdp x tp pod. Under
+``HVD_TPU_FAULT_SPEC=worker.mesh:crash:step=4:rank=1`` rank 1 hard-dies
+entering its 4th sharded step; the driver replans the mesh (dp=2 ->
+dp=1), the survivor re-execs, adopts the published shape, restores the
+last committed sharded checkpoint through the resharding reader, and
+finishes the fixed step budget. Training is deterministic (per-step
+seeded data, SGD+momentum), so the final parameters must be
+bit-identical to an uninterrupted 1-host run's.
+
+Per-step parameter fingerprints run replica-group-scoped
+(``FingerprintMonitor.for_mesh``): in the dp=2 generation ranks 0 and 1
+hold bit-identical replicas and are compared; after the reshape the
+lone survivor publishes without comparing. Any detection logs an
+``sdc`` event — the harness asserts there are none (zero false trips).
+
+Env contract from the harness:
+  ELASTIC_TEST_DIR        shared scratch dir (events.log + ckpt/)
+  MESH_TEST_STEPS         total optimizer steps (default 6)
+  MESH_TEST_LOCAL_SHAPE   per-process device-mesh spec (default fsdp=2)
+  MESH_TEST_LOCAL_DEVICES forced CPU device count (default 2)
+"""
+
+import hashlib
+import os
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# the local device mesh needs real (forced-CPU) devices to shard over;
+# must be set before jax imports
+os.environ["XLA_FLAGS"] = (
+    "--xla_force_host_platform_device_count="
+    + os.environ.get("MESH_TEST_LOCAL_DEVICES", "2"))
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+import optax  # noqa: E402
+
+import horovod_tpu as hvd  # noqa: E402
+from horovod_tpu.checkpointing import CheckpointManager  # noqa: E402
+from horovod_tpu.models.transformer import TransformerConfig  # noqa: E402
+from horovod_tpu.parallel import mesh_utils  # noqa: E402
+from horovod_tpu.parallel import train as ptrain  # noqa: E402
+from horovod_tpu.sdc import FingerprintMonitor  # noqa: E402
+
+TEST_DIR = os.environ["ELASTIC_TEST_DIR"]
+STEPS = int(os.environ.get("MESH_TEST_STEPS", "6"))
+LOCAL_SHAPE = os.environ.get("MESH_TEST_LOCAL_SHAPE", "fsdp=2")
+LOG_PATH = os.path.join(TEST_DIR, "events.log")
+CKPT_DIR = os.path.join(TEST_DIR, "ckpt")
+
+
+def log_event(msg: str) -> None:
+    with open(LOG_PATH, "a") as f:
+        f.write(f"{msg} t={time.time():.3f}\n")
+        f.flush()
+
+
+def batch_for_step(step: int, cfg):
+    """Deterministic per-step batch: every generation (and the
+    uninterrupted reference run) sees the same data at the same step."""
+    rng = np.random.RandomState(1000 + step)
+    toks = rng.randint(0, cfg.vocab_size,
+                       size=(4, cfg.max_seq_len)).astype(np.int32)
+    tgts = rng.randint(0, cfg.vocab_size,
+                       size=(4, cfg.max_seq_len)).astype(np.int32)
+    return toks, tgts
+
+
+def build_bundle():
+    cfg = TransformerConfig(vocab_size=32, num_layers=1, d_model=16,
+                            num_heads=2, head_dim=8, mlp_ratio=2,
+                            max_seq_len=8, dtype=jnp.float32)
+    # local_devices, not devices: under the elastic launcher each
+    # generation runs with jax.distributed initialized, where
+    # jax.devices() is the GLOBAL device list across processes — this
+    # worker's mesh is deliberately host-local (each process is one dp
+    # replica computing the full batch; bit-identical across world
+    # sizes), so only its own forced-CPU devices belong in it
+    mesh = mesh_utils.make_training_mesh(
+        mesh_utils.mesh_config_from_spec(LOCAL_SHAPE), jax.local_devices())
+    # momentum gives the optimizer real state leaves, so a resume that
+    # dropped opt_state would NOT be bit-identical — the restore is
+    # proven, not assumed
+    bundle = ptrain.make_transformer_train_step(
+        cfg, mesh, optimizer=optax.sgd(0.1, momentum=0.9))
+    return cfg, bundle
+
+
+def params_sha(params) -> str:
+    digest = hashlib.sha256()
+    for leaf in jax.tree_util.tree_leaves(params):
+        digest.update(np.ascontiguousarray(np.asarray(leaf)).tobytes())
+    return digest.hexdigest()
+
+
+def main():
+    hvd.init()
+    manager = CheckpointManager(CKPT_DIR)
+    state = hvd.elastic.ObjectState(step=0)
+
+    @hvd.elastic.run
+    def train(state):
+        # rebuilt every generation: a re-exec'd survivor lands here with
+        # a fresh interpreter, a new world size, and the driver's newly
+        # planned mesh shape waiting in the rendezvous 'mesh' scope
+        shape = hvd.elastic.fetch_mesh_shape() or {}
+        dp = int(shape.get("dp") or hvd.size())
+        cfg, bundle = build_bundle()
+        restored = ptrain.restore_mesh_train_state(manager, bundle)
+        state.step = 0 if restored is None else restored + 1
+        monitor = None
+        if hvd.size() % max(dp, 1) == 0:
+            monitor = FingerprintMonitor.for_mesh(
+                hvd.size(), hvd.rank(), dp=dp, every=1)
+        log_event(f"mesh rank={hvd.rank()} size={hvd.size()} dp={dp} "
+                  f"local={LOCAL_SHAPE} restored={restored} "
+                  f"start={state.step}")
+        while state.step < STEPS:
+            toks, tgts = batch_for_step(state.step, cfg)
+            toks = jax.device_put(jnp.asarray(toks), bundle.batch_sharding)
+            tgts = jax.device_put(jnp.asarray(tgts), bundle.batch_sharding)
+            loss = ptrain.run_mesh_step(bundle, toks, tgts)
+            if monitor is not None:
+                det = monitor.maybe_check(state.step, bundle.params)
+                if det is not None:
+                    log_event(f"sdc rank={hvd.rank()} step={state.step} "
+                              f"local={det.local}")
+            log_event(f"step={state.step} rank={hvd.rank()} "
+                      f"size={hvd.size()} loss={float(loss):.6f}")
+            state.step += 1
+            # commit BEFORE the sharded save: its rank-synchronizing
+            # broadcast is the failure detector — a peer that died this
+            # step surfaces here as HorovodInternalError, so the save
+            # below only ever runs against a fully-alive generation
+            # (the multihost manifest merge waits on every process's
+            # shard index and must not be entered with a dead peer)
+            state.commit()
+            ptrain.save_mesh_train_state(manager, state.step - 1, bundle)
+        return bundle
+
+    bundle = train(state)
+    log_event(f"done rank={hvd.rank()} size={hvd.size()} "
+              f"steps={state.step} sha={params_sha(bundle.params)}")
+    hvd.shutdown()
+
+
+if __name__ == "__main__":
+    main()

@@ -243,6 +243,24 @@ def test_chaos_sdc_suite_is_seeded_and_exclusive():
     assert os.path.exists(os.path.join(root, "tests", "test_sdc.py"))
 
 
+def test_chaos_mesh_suite_is_seeded_and_exclusive():
+    """The mesh-aware elastic recovery drills (reshape-policy units,
+    replica-group-scoped fingerprints, driver mesh plane, shard-handoff
+    restore, the seeded 2-proc worker.mesh kill drill) run as their own
+    seeded CI suite; the generic unit and chaos suites must not run the
+    same file twice."""
+    by_name = {name: cmd for name, cmd, _t in COMMON_SUITES}
+    assert "chaos-mesh" in by_name
+    cmd = by_name["chaos-mesh"]
+    assert "HVD_TPU_FAULT_SEED=" in cmd
+    assert "tests/test_mesh_elastic.py" in cmd
+    assert "--ignore=tests/test_mesh_elastic.py" in by_name["unit"]
+    assert "--ignore=tests/test_mesh_elastic.py" in by_name["chaos"]
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    assert os.path.exists(os.path.join(root, "tests",
+                                       "test_mesh_elastic.py"))
+
+
 def test_observability_suite_is_seeded_and_exclusive():
     """The per-request tracing suite (span propagation units, the
     zero-overhead contract, the tools.trace merger, the seeded 2-proc
