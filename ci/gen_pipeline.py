@@ -46,6 +46,7 @@ COMMON_SUITES = [
      "--ignore=tests/test_sdc.py "
      "--ignore=tests/test_tracing.py "
      "--ignore=tests/test_failover.py "
+     "--ignore=tests/test_disagg.py "
      "--ignore=tests/test_mesh_elastic.py", 30),
     ("chaos", "python -m pytest tests/ -q -m chaos "
      "--ignore=tests/test_coordinator_recovery.py "
@@ -59,6 +60,7 @@ COMMON_SUITES = [
      "--ignore=tests/test_sdc.py "
      "--ignore=tests/test_tracing.py "
      "--ignore=tests/test_failover.py "
+     "--ignore=tests/test_disagg.py "
      "--ignore=tests/test_mesh_elastic.py", 20),
     # coordinator-kill + heartbeat-timeout drills, seeded so every run
     # replays the same fault schedule; owns its test file exclusively
@@ -115,6 +117,16 @@ COMMON_SUITES = [
      "python -m pytest tests/test_generation.py "
      "tests/test_generation_sampling.py "
      "tests/test_generation_prefix.py -q", 20),
+    # disaggregated prefill/decode serving: the KV-block wire codec,
+    # allocator export/import round trips, pool-split fleet bit-parity
+    # (greedy + seeded sampling, logprobs included), zero-byte warm
+    # shared-prefix transfers, the transfer deadline stage, and the
+    # seeded disagg.transfer mid-transfer kill drill (decode-side
+    # re-prefill, zero client-visible errors, bit-identical stream) —
+    # pinned seed; owns its file exclusively (unit+chaos ignore it)
+    ("serving-disagg",
+     "env HVD_TPU_FAULT_SEED=1234 "
+     "python -m pytest tests/test_disagg.py -q", 20),
     # silent-data-corruption defense: the step guard (finite/magnitude +
     # loss-spike EWMA), cross-replica fingerprints, skip/rollback/
     # quarantine policy, and the seeded worker.grads bitflip e2e drill

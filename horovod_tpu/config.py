@@ -743,6 +743,38 @@ FLEET_RETRY_BUDGET_BURST = _register(
          "HVD_TPU_FLEET_RETRY_BUDGET_RATIO accrual becomes the "
          "limiting rate.")
 
+# -- Disaggregated prefill/decode serving (serving/disagg/: pool-split
+#    fleet with content-addressed KV-block shipping) ------------------------
+DISAGG_ROLE = _register(
+    "DISAGG_ROLE", "colocated", str,
+    help="Operating mode of this replica's generation plane: "
+         "'colocated' (default) serves prefill AND decode exactly as "
+         "before; 'prefill' runs chunked prefill into the paged cache, "
+         "registers the prompt's full blocks in the prefix-cache index, "
+         "discards the sampled token, and answers /v1/generate with a "
+         "content-addressed KV manifest instead of tokens; 'decode' "
+         "serves generation normally but is the fleet's target for "
+         "POST /v1/kv/offer — transferred blocks register into its "
+         "BlockAllocator so admission attaches them with zero "
+         "full-block prefill debt. Byte-compatible: every colocated "
+         "path is untouched at the default.")
+DISAGG_WIRE_DTYPE = _register(
+    "DISAGG_WIRE_DTYPE", "native", str,
+    help="Element dtype for KV-block payloads on the /v1/kv/fetch "
+         "wire: 'native' (default) ships the pool dtype bit-exactly "
+         "(required for the disagg-vs-colocated bit-parity guarantee "
+         "when pools are fp32); 'bf16' packs blocks through the PR 7 "
+         "bfloat16 wire codec, halving transfer bytes — lossless only "
+         "when the pools are already bf16.")
+DISAGG_FETCH_TIMEOUT_S = _register(
+    "DISAGG_FETCH_TIMEOUT_S", 5.0, float,
+    help="Socket timeout (seconds) for the decode replica's "
+         "POST /v1/kv/fetch pull of missing KV-block payloads from the "
+         "prefill replica. On expiry (or any fetch failure — e.g. the "
+         "prefill replica died mid-transfer) the offer degrades to a "
+         "decode-side re-prefill: correctness is never a function of "
+         "the transfer completing.")
+
 # -- Misc -------------------------------------------------------------------
 NUM_STREAMS = _register(
     "NUM_STREAMS", 1, int, alias="HOROVOD_NUM_NCCL_STREAMS",

@@ -778,7 +778,8 @@ def prefix_sweep(num_requests: int = 24, batch_slots: int = 8,
             "prefill_tokens": int(delta(
                 'hvd_tpu_gen_tokens_total{phase="prefill"}')),
             "hit_tokens": int(delta(
-                "hvd_tpu_gen_prefix_cache_hit_tokens_total")),
+                'hvd_tpu_gen_prefix_cache_hit_tokens_total'
+                '{source="local"}')),
             "miss_tokens": int(delta(
                 "hvd_tpu_gen_prefix_cache_miss_tokens_total")),
             "evictions": int(delta(
@@ -1166,4 +1167,164 @@ def resume_sweep(emitted: int = 256, prompt_len: int = 8,
         "resume_first_token_ms_cache_on": ms_on,
         "resume_first_token_ms_cache_off": ms_off,
         "cached_resume_speedup": round(ms_off / max(ms_on, 1e-9), 2),
+    }
+
+
+def disagg_sweep(num_requests: int = 16, batch_slots: int = 8,
+                 block_size: int = 16) -> dict:
+    """Disaggregated prefill/decode serving vs colocated (ISSUE 19's
+    acceptance pair), end to end through real HTTP fleets.
+
+    The same mixed long-prefill/long-decode workload (the
+    :func:`prefix_sweep` shared-64-token-system-prompt shape, whose
+    long prompts are exactly what stalls colocated decodes) runs twice
+    over the same compiled programs:
+
+    * **colocated** — two ``role='colocated'`` replicas behind a plain
+      :class:`FleetRouter` (the PR 13 fleet, least-outstanding).
+    * **pooled** — one prefill replica + one decode replica behind a
+      pooled router: every request prestages on the prefill pool, the
+      KV manifest is offered to the decode replica, and only missing
+      blocks move (``hvd_tpu_disagg_transfer_bytes_total``).
+
+    Outputs are asserted bit-identical across modes (the disagg
+    correctness contract), and a fully-warm repeat request through the
+    pooled fleet is asserted to move ZERO transfer bytes — the
+    content-addressed dedup acceptance number. Reported per mode: wall
+    seconds, useful tokens/sec, and per-request latency p50/p99; the
+    pooled row adds transfer bytes/seconds and the
+    ``source="transfer"`` prefix-hit tokens."""
+    import json as _json
+    import threading
+    import urllib.request
+
+    from . import metrics as _metrics
+    from .serving import InferenceServer
+    from .serving import fleet
+    from .serving.generation import GenerationEngine
+
+    system_tokens = 64
+    model, params, cfg, prompts, new_lens = _gen_workload(
+        num_requests, shared_prefix=system_tokens)
+    total_new = sum(new_lens)
+    max_blocks = -(-cfg.max_seq_len // block_size)
+    num_blocks = batch_slots * max_blocks + 1
+
+    def make_replica(role):
+        eng = GenerationEngine(
+            model, params=params, block_size=block_size,
+            num_blocks=num_blocks, max_seqs=batch_slots,
+            prefill_chunk=16, queue_depth=num_requests, deadline_ms=0,
+            role=role)
+        srv = InferenceServer(None, port=0, addr="127.0.0.1",
+                              gen_engine=eng)
+        srv.start()
+        return srv
+
+    def post(url, doc):
+        req = urllib.request.Request(
+            url, data=_json.dumps(doc).encode(), method="POST",
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=600) as resp:
+            return _json.loads(resp.read())
+
+    TB = "hvd_tpu_disagg_transfer_bytes_total"
+    TS = "hvd_tpu_disagg_transfer_seconds"
+    HIT_T = ('hvd_tpu_gen_prefix_cache_hit_tokens_total'
+             '{source="transfer"}')
+
+    def run(pooled):
+        if pooled:
+            srvs = {"p0": make_replica("prefill"),
+                    "d0": make_replica("decode")}
+            pools = {"p0": "prefill", "d0": "decode"}
+        else:
+            srvs = {"r0": make_replica("colocated"),
+                    "r1": make_replica("colocated")}
+            pools = None
+        router = fleet.FleetRouter(
+            {rid: f"http://127.0.0.1:{s.port}"
+             for rid, s in srvs.items()},
+            port=0, addr="127.0.0.1", pools=pools)
+        router.start()
+        outs = [None] * num_requests
+        lat = [0.0] * num_requests
+        try:
+            snap0 = _metrics.snapshot()
+            t0 = time.perf_counter()
+
+            def client(i):
+                t1 = time.perf_counter()
+                outs[i] = post(router.url + "/v1/generate",
+                               {"prompt": prompts[i],
+                                "max_tokens": new_lens[i]})["tokens"]
+                lat[i] = (time.perf_counter() - t1) * 1e3
+            # request 0 runs alone first — in the pooled fleet its cold
+            # transfer ships the shared system prompt once, so the
+            # burst's offers dedup against it
+            client(0)
+            threads = [threading.Thread(target=client, args=(i,))
+                       for i in range(1, num_requests)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            wall = time.perf_counter() - t0
+            snap1 = _metrics.snapshot()
+
+            # fully-warm repeat: every manifest block of prompt 0 is
+            # already indexed on the serving replica — the pooled hop
+            # must move ZERO bytes (content-addressed dedup)
+            repeat = post(router.url + "/v1/generate",
+                          {"prompt": prompts[0],
+                           "max_tokens": new_lens[0]})["tokens"]
+            snap2 = _metrics.snapshot()
+            assert repeat == outs[0], "warm repeat diverged"
+            warm_bytes = snap2.get(TB, 0) - snap1.get(TB, 0)
+            if pooled:
+                assert warm_bytes == 0, \
+                    f"warm shared prefix moved {warm_bytes} bytes"
+        finally:
+            router.stop()
+            for s in srvs.values():
+                s.close()
+
+        def delta(key):
+            return snap1.get(key, 0) - snap0.get(key, 0)
+
+        lat_np = np.asarray(lat)
+        row = {
+            "wall_s": round(wall, 3),
+            "tokens_per_s": round(total_new / wall, 1),
+            "p50_ms": round(float(np.percentile(lat_np, 50)), 2),
+            "p99_ms": round(float(np.percentile(lat_np, 99)), 2),
+        }
+        if pooled:
+            row["transfer_bytes"] = int(delta(TB))
+            row["transfer_seconds"] = round(delta(TS), 4)
+            row["transfer_hit_tokens"] = int(delta(HIT_T))
+            row["warm_repeat_transfer_bytes"] = int(warm_bytes)
+        return row, outs
+
+    # compile + warm both paths off the clock (fresh replicas per run;
+    # only the jit caches are shared across runs)
+    run(pooled=False)
+    run(pooled=True)
+    colo, colo_outs = run(pooled=False)
+    pool, pool_outs = run(pooled=True)
+    mismatch = sum(colo_outs[i] != pool_outs[i]
+                   for i in range(num_requests))
+    assert mismatch == 0, f"{mismatch} sequences diverged across modes"
+
+    return {
+        "scenario": "disagg_prefill_decode",
+        "num_requests": num_requests,
+        "batch_slots": batch_slots,
+        "block_size": block_size,
+        "num_blocks": num_blocks,
+        "system_prompt_tokens": system_tokens,
+        "total_new_tokens": total_new,
+        "bit_identical": True,
+        "colocated": colo,
+        "pooled": pool,
     }

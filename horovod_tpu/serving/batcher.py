@@ -74,17 +74,19 @@ _M_DEADLINE_STAGE = _metrics.counter(
     "Requests shed because their end-to-end budget (X-HVD-TPU-Deadline-"
     "Ms) died, by the pipeline stage that noticed: 'route' (router "
     "proxy, budget gone before any replica was touched), 'queue' "
-    "(fair-queue / micro-batch / prefill-admission wait), 'prefill' "
-    "(mid-prefill, before the next chunk ran), 'decode' (between "
-    "generated tokens). The same stage is returned to the client in "
-    "the X-HVD-TPU-Deadline-Exceeded response header.",
+    "(fair-queue / micro-batch / prefill-admission wait), 'transfer' "
+    "(the disagg prefill->decode KV hop: budget spent before or "
+    "during /v1/kv/offer), 'prefill' (mid-prefill, before the next "
+    "chunk ran), 'decode' (between generated tokens). The same stage "
+    "is returned to the client in the X-HVD-TPU-Deadline-Exceeded "
+    "response header.",
     labels=("stage",))
 
 #: end-to-end budget header: remaining milliseconds, minted at the
 #: fleet router and re-stamped (decremented) on every forwarded hop
 DEADLINE_HEADER = "X-HVD-TPU-Deadline-Ms"
 #: stamped on 429 responses: the pipeline stage where the budget died
-#: (route | queue | prefill | decode)
+#: (route | queue | transfer | prefill | decode)
 DEADLINE_STAGE_HEADER = "X-HVD-TPU-Deadline-Exceeded"
 
 
@@ -101,7 +103,7 @@ class QueueFullError(RejectedError):
 class DeadlineExceededError(RejectedError):
     """The request's deadline expired (HTTP 429 at the front-end).
     ``stage`` names the pipeline stage that noticed the dead budget
-    (route | queue | prefill | decode) for the
+    (route | queue | transfer | prefill | decode) for the
     X-HVD-TPU-Deadline-Exceeded response header; shedding sites that
     know their stage count it in
     ``hvd_tpu_serving_deadline_stage_total``."""

@@ -156,13 +156,17 @@ class _Replica:
     """Router-side record for one replica endpoint (state guarded by the
     router lock; ``outstanding`` also mirrors to the gauge)."""
 
-    __slots__ = ("id", "base_url", "outstanding", "draining", "hb_dead",
-                 "circuit_open", "failure_streak", "probe_attempt",
-                 "next_probe_at")
+    __slots__ = ("id", "base_url", "pool", "outstanding", "draining",
+                 "hb_dead", "circuit_open", "failure_streak",
+                 "probe_attempt", "next_probe_at")
 
-    def __init__(self, replica_id: str, base_url: str):
+    def __init__(self, replica_id: str, base_url: str,
+                 pool: str = "colocated"):
         self.id = replica_id
         self.base_url = base_url.rstrip("/")
+        # disagg pool membership: "prefill" | "decode" | "colocated"
+        # (a colocated replica serves BOTH pools)
+        self.pool = pool
         self.outstanding = 0
         self.draining = False
         self.hb_dead = False
@@ -170,6 +174,10 @@ class _Replica:
         self.failure_streak = 0
         self.probe_attempt = 0
         self.next_probe_at = 0.0
+
+    def in_pool(self, pool: Optional[str]) -> bool:
+        return pool is None or self.pool == pool \
+            or self.pool == "colocated"
 
     @property
     def routable(self) -> bool:
@@ -189,7 +197,9 @@ class _RouterHandler(_http.QuietHandler):
     """Front-end handler; all logic lives on ``self.server.router``."""
 
     def do_GET(self):  # noqa: N802 — BaseHTTPRequestHandler contract
-        if self.path.split("?", 1)[0] != "/healthz":
+        # /fleet/health is the control-plane alias of /healthz: same
+        # document (pool topology, per-pool routable counts, tenants)
+        if self.path.split("?", 1)[0] not in ("/healthz", "/fleet/health"):
             self._send(404, {"error": "not found"})
             return
         self._send(200, self.server.router.health_doc())
@@ -255,7 +265,8 @@ class FleetRouter:
                  tenants: Optional[TenantRegistry] = None,
                  heartbeat_timeout: Optional[float] = None,
                  heartbeat_interval: Optional[float] = None,
-                 request_timeout: Optional[float] = None):
+                 request_timeout: Optional[float] = None,
+                 pools: Optional[Mapping[str, str]] = None):
         cfg = _config.live_config()
         if isinstance(replicas, Mapping):
             items = list(replicas.items())
@@ -263,12 +274,36 @@ class FleetRouter:
             items = [(f"r{i}", url) for i, url in enumerate(replicas)]
         if not items:
             raise ValueError("FleetRouter needs at least one replica")
+        # disagg pool membership (replica id -> prefill|decode|colocated;
+        # ids absent from ``pools`` stay colocated). Must mirror each
+        # replica's own HVD_TPU_DISAGG_ROLE — the router routes by this
+        # map, the replica behaves by its role knob.
+        pools = dict(pools or {})
         self._replicas: Dict[str, _Replica] = {}
         for replica_id, url in items:
             url = str(url)
             if "//" not in url:
                 url = "http://" + url
-            self._replicas[str(replica_id)] = _Replica(str(replica_id), url)
+            pool = str(pools.pop(str(replica_id), "colocated"))
+            if pool not in ("prefill", "decode", "colocated"):
+                raise ValueError(
+                    f"replica {replica_id!r}: pool must be one of "
+                    f"prefill|decode|colocated, got {pool!r}")
+            self._replicas[str(replica_id)] = _Replica(
+                str(replica_id), url, pool=pool)
+        if pools:
+            raise ValueError(f"pools= names unknown replicas: "
+                             f"{sorted(pools)}")
+        # the fleet runs disaggregated iff any replica is pool-split;
+        # fixed at construction, so the request path reads it lock-free
+        self._disagg = any(r.pool != "colocated"
+                           for r in self._replicas.values())
+        if self._disagg and not all(
+                any(r.in_pool(p) for r in self._replicas.values())
+                for p in ("prefill", "decode")):
+            raise ValueError("a disaggregated fleet needs at least one "
+                             "replica in each of the prefill and decode "
+                             "pools (colocated replicas count for both)")
         self._lock = _locks.lock("fleet.FleetRouter._lock")
         self._requested_port = int(cfg.get(_config.FLEET_PORT)
                                    if port is None else port)
@@ -287,7 +322,9 @@ class FleetRouter:
             max_backoff=float(cfg.get(_config.FLEET_PROBE_MAX_BACKOFF)))
         self.tenants = tenants if tenants is not None else TenantRegistry(
             cfg=cfg)
-        self.scheduler = FairScheduler(capacity_fn=self._capacity)
+        self.scheduler = FairScheduler(
+            capacity_fn=self._capacity,
+            capacity_detail_fn=self._capacity_detail)
         self.retry_budget = RetryBudget()
         self._default_deadline_ms = float(
             cfg.get(_config.FLEET_DEFAULT_DEADLINE_MS))
@@ -309,9 +346,13 @@ class FleetRouter:
             on_dead=self._on_replica_dead, on_alive=self._on_replica_alive,
             timeout=hb_timeout, poll_interval=max(0.05, hb_interval),
             label="fleet", thread_name="hvd-fleet-hb-monitor")
-        #: routable-replica count, mirrored on every health/drain change;
-        #: read lock-free by the scheduler's capacity_fn
+        #: routable-replica counts, mirrored on every health/drain
+        #: change; read lock-free by the scheduler's capacity_fn. The
+        #: per-pool counts include colocated replicas in both pools.
         self._routable_count = len(self._replicas)
+        self._pool_routable = {
+            p: sum(1 for r in self._replicas.values() if r.in_pool(p))
+            for p in ("prefill", "decode")}
         self._httpd = None
         self._stop_probe = threading.Event()
         self._probe_thread: Optional[threading.Thread] = None
@@ -378,13 +419,23 @@ class FleetRouter:
     def health_doc(self) -> dict:
         with self._lock:
             replicas = {r.id: {"state": r.state(),
+                               "pool": r.pool,
                                "outstanding": r.outstanding,
                                "url": r.base_url}
                         for r in self._replicas.values()}
             routable = self._routable_count
-        return {"status": "routing" if routable else "degraded",
-                "routable": routable, "replicas": replicas,
-                "tenants": self.scheduler.stats()}
+            effective = self._effective_routable()
+            pool_routable = dict(self._pool_routable)
+        doc = {"status": "routing" if effective else "degraded",
+               "routable": routable, "replicas": replicas,
+               "disagg": self._disagg,
+               "admission": self.scheduler.capacity(),
+               "tenants": self.scheduler.stats()}
+        if self._disagg:
+            # per-pool routable counts: the min is the fleet's
+            # effective width (colocated replicas count in both)
+            doc["pools"] = pool_routable
+        return doc
 
     def observe_beat(self, replica_id: str) -> bool:
         if replica_id not in self._replicas:
@@ -425,12 +476,33 @@ class FleetRouter:
     def _recount_locked(self) -> None:
         self._routable_count = sum(
             1 for r in self._replicas.values() if r.routable)
+        self._pool_routable = {
+            p: sum(1 for r in self._replicas.values()
+                   if r.routable and r.in_pool(p))
+            for p in ("prefill", "decode")}
+
+    def _effective_routable(self) -> int:
+        """Replicas that bound fleet capacity: the full routable count
+        colocated, the NARROWEST pool disaggregated — every request
+        crosses both pools, so the thin pool is the throughput wall."""
+        if not self._disagg:
+            return self._routable_count
+        return min(self._pool_routable.values())
 
     def _capacity(self) -> int:
         # lock-free read (called under the scheduler lock; taking the
         # router lock here would nest the two in the opposite order of
         # set_draining -> scheduler.kick)
-        return self._routable_count * self._per_replica
+        return self._effective_routable() * self._per_replica
+
+    def _capacity_detail(self) -> dict:
+        """Per-pool capacity breakdown for FairScheduler introspection
+        (lock-free, same rationale as :meth:`_capacity`)."""
+        doc = {"per_replica": self._per_replica,
+               "routable": self._routable_count}
+        if self._disagg:
+            doc["pools"] = dict(self._pool_routable)
+        return doc
 
     def _kick_scheduler(self) -> None:
         """Re-run grants after a capacity change; when the change took
@@ -441,7 +513,9 @@ class FleetRouter:
         capacity_fn()==0 read: a scheduler constructed with zero
         capacity (unit tests, pre-start wiring) must still queue."""
         self.scheduler.kick()
-        if self._routable_count == 0:
+        if self._effective_routable() == 0:
+            # disaggregated: an EMPTY pool zeroes capacity even with
+            # the other pool healthy — flush for the same reason
             self.scheduler.flush_no_capacity()
 
     def _on_replica_dead(self, replica_id: str, _meta: str) -> None:
@@ -543,20 +617,43 @@ class FleetRouter:
             self._note_success(replica_id)
 
     # -- request path --------------------------------------------------------
-    def _pick(self, exclude) -> Optional[_Replica]:
+    def _pick(self, exclude, pool: Optional[str] = None,
+              prefer: Optional[str] = None,
+              strict: bool = False) -> Optional[_Replica]:
         """Least-outstanding routable replica (claims one outstanding
         slot); ``exclude`` holds replica ids already failed this
-        request."""
+        request. ``pool`` restricts candidates to one disagg pool
+        (colocated replicas belong to both, unless ``strict``);
+        ``prefer`` names the replica to take when it is still eligible
+        — the decode replica already holding this request's transferred
+        KV blocks beats the load-balance pick."""
         with self._lock:
             candidates = [r for r in self._replicas.values()
-                          if r.routable and r.id not in exclude]
+                          if r.routable and r.id not in exclude
+                          and (r.pool == pool if strict
+                               else r.in_pool(pool))]
             if not candidates:
                 return None
-            replica = min(candidates, key=lambda r: (r.outstanding, r.id))
+            preferred = [r for r in candidates if r.id == prefer]
+            replica = preferred[0] if preferred else min(
+                candidates, key=lambda r: (r.outstanding, r.id))
             replica.outstanding += 1
             outstanding = replica.outstanding
         _M_OUTSTANDING.labels(replica=replica.id).set(outstanding)
         return replica
+
+    def _peek(self, pool: Optional[str] = None) -> Optional[str]:
+        """Least-outstanding routable replica id in ``pool`` WITHOUT
+        claiming a slot — the prestage hop's way of choosing the decode
+        replica it will transfer KV to, before the generate forward
+        claims it for real (via ``prefer=``)."""
+        with self._lock:
+            candidates = [r for r in self._replicas.values()
+                          if r.routable and r.in_pool(pool)]
+            if not candidates:
+                return None
+            return min(candidates,
+                       key=lambda r: (r.outstanding, r.id)).id
 
     def _done(self, replica: _Replica) -> None:
         with self._lock:
@@ -639,14 +736,138 @@ class FleetRouter:
                                        getattr(e, "stage", None)})
                 return
             try:
+                pool = prefer = None
+                if self._disagg and path in ("/v1/generate",
+                                             "/v1/generate/stream"):
+                    # disaggregated generate: run prefill on the
+                    # prefill pool and ship the KV blocks to the decode
+                    # replica we are about to hand the stream to; any
+                    # prestage failure degrades to a cold decode-pool
+                    # forward (the replica re-prefills locally)
+                    pool = "decode"
+                    status, prefer = self._disagg_prestage(
+                        body, request_id, tenant.name, budget_ts)
+                    if status == "shed":
+                        # budget died inside the KV hop: the shed is
+                        # the TRANSFER stage's (constructing the error
+                        # attributes it on the stage counter)
+                        e = DeadlineExceededError(
+                            "end-to-end deadline spent in the disagg "
+                            "KV transfer", stage="transfer")
+                        handler._send(
+                            429, {"error": str(e), "stage": "transfer"},
+                            request_id,
+                            headers={DEADLINE_STAGE_HEADER: "transfer"})
+                        return
                 if path == "/v1/generate/stream":
                     self._forward_stream(handler, path, body, request_id,
-                                         tenant.name, budget_ts)
+                                         tenant.name, budget_ts,
+                                         pool=pool, prefer=prefer)
                 else:
                     self._forward(handler, path, body, request_id,
-                                  tenant.name, budget_ts)
+                                  tenant.name, budget_ts,
+                                  pool=pool, prefer=prefer)
             finally:
                 self.scheduler.release(tenant)
+
+    # -- disaggregated prestage (prefill pool -> decode pool KV hop) ---------
+    def _disagg_prestage(self, body: bytes, request_id: str,
+                         tenant_name: str,
+                         budget_ts: Optional[float]
+                         ) -> Tuple[str, Optional[str]]:
+        """Run the KV hop for one generate request: prefill the prompt
+        on the prefill pool, then offer the resulting content-addressed
+        manifest to the decode replica the generate forward should pin
+        (``prefer=``). Returns ``(status, decode_replica_id)``:
+
+        * ``("ok", id)`` — blocks offered (or nothing worth shipping);
+          forward to ``id`` for zero-debt admission;
+        * ``("cold", id_or_None)`` — the hop failed somewhere
+          non-fatal (prefill pool empty/unreachable, offer refused);
+          forward normally, the decode replica re-prefills locally.
+          NEVER client-visible: degradation is the disagg contract;
+        * ``("shed", None)`` — the end-to-end budget died inside the
+          hop; the request is over, attributed to the ``transfer``
+          stage.
+        """
+        decode_id = self._peek(pool="decode")
+        # strictly-prefill replicas only: a colocated replica answering
+        # /v1/generate would run the FULL generation, not a prefill
+        prefill = self._pick(set(), pool="prefill", strict=True)
+        if decode_id is None or prefill is None:
+            if prefill is not None:
+                self._done(prefill)
+            return ("cold", decode_id)
+        try:
+            req = urllib.request.Request(
+                prefill.base_url + "/v1/generate", data=body,
+                method="POST",
+                headers=self._headers_for(request_id, 0, budget_ts))
+            with urllib.request.urlopen(
+                    req, timeout=self._request_timeout) as resp:
+                doc = json.loads(resp.read())
+            self._note_success(prefill.id)
+        except urllib.error.HTTPError as e:
+            if e.code >= 500:
+                self._note_failure(prefill.id)
+            else:
+                self._note_success(prefill.id)
+            log.warning("fleet: request %s (tenant %s): prefill-pool "
+                        "prestage rejected by %s (%d); decoding cold",
+                        request_id, tenant_name, prefill.id, e.code)
+            return ("cold", decode_id)
+        except Exception as e:  # noqa: BLE001 — connect/read failure
+            self._note_failure(prefill.id)
+            log.warning("fleet: request %s (tenant %s): prefill replica "
+                        "%s unreachable (%s); decoding cold",
+                        request_id, tenant_name, prefill.id, e)
+            return ("cold", decode_id)
+        finally:
+            self._done(prefill)
+        manifest = doc.get("manifest") or {}
+        hashes = [str(h) for h in manifest.get("hashes") or []]
+        source = manifest.get("source")
+        if not hashes:
+            # short prompt: nothing block-aligned to ship — the decode
+            # replica's sub-block prefill IS the cheapest path
+            return ("ok", decode_id)
+        left = self._budget_left_ms(budget_ts)
+        if left is not None and left <= 0:
+            # constructing the error attributes the shed on the
+            # transfer stage's counter (batcher.py idiom)
+            DeadlineExceededError(
+                "end-to-end deadline spent before the KV offer",
+                stage="transfer")
+            return ("shed", None)
+        try:
+            with _tracing.span("disagg.offer",
+                               args={"prefill": prefill.id,
+                                     "decode": decode_id,
+                                     "blocks": len(hashes)}):
+                req = urllib.request.Request(
+                    self.replica_url(decode_id) + "/v1/kv/offer",
+                    data=json.dumps({"hashes": hashes,
+                                     "source": source}).encode("utf-8"),
+                    method="POST",
+                    headers=self._headers_for(request_id, 0, budget_ts))
+                with urllib.request.urlopen(
+                        req, timeout=self._request_timeout) as resp:
+                    json.loads(resp.read())
+        except urllib.error.HTTPError as e:
+            if e.code == 429 and e.headers.get(
+                    DEADLINE_STAGE_HEADER) == "transfer":
+                # the decode replica shed the offer on budget (and
+                # already attributed it): the request is over
+                return ("shed", None)
+            log.warning("fleet: request %s: KV offer to %s rejected "
+                        "(%d); decoding cold", request_id, decode_id,
+                        e.code)
+            return ("cold", decode_id)
+        except Exception as e:  # noqa: BLE001 — offer failure degrades
+            log.warning("fleet: request %s: KV offer to %s failed (%s); "
+                        "decoding cold", request_id, decode_id, e)
+            return ("cold", decode_id)
+        return ("ok", decode_id)
 
     # -- forwarding helpers --------------------------------------------------
     def _budget_left_ms(self, budget_ts: Optional[float]) -> Optional[float]:
@@ -752,7 +973,9 @@ class FleetRouter:
 
     def _forward(self, handler: _RouterHandler, path: str, body: bytes,
                  request_id: str, tenant_name: str,
-                 budget_ts: Optional[float]) -> None:
+                 budget_ts: Optional[float],
+                 pool: Optional[str] = None,
+                 prefer: Optional[str] = None) -> None:
         try:
             _FP_ROUTE.fire()
         except _faults.InjectedFault as e:
@@ -769,7 +992,8 @@ class FleetRouter:
             if left is not None and left <= 0:
                 self._budget_died(handler, request_id)
                 return
-            replica = self._pick(exclude)
+            replica = self._pick(exclude, pool=pool, prefer=prefer)
+            prefer = None    # only the first attempt gets the KV pin
             if replica is None:
                 log.warning("fleet: request %s (tenant %s): no routable "
                             "replica", request_id, tenant_name)
@@ -795,7 +1019,8 @@ class FleetRouter:
                     # tenant still has retry budget and the fleet has a
                     # second replica to spare
                     if self.retry_budget.try_spend(tenant_name):
-                        hedge = self._pick(exclude | {replica.id})
+                        hedge = self._pick(exclude | {replica.id},
+                                           pool=pool)
                         if hedge is not None:
                             attempt += 1
                             _M_HEDGES.labels(outcome="launched").inc()
@@ -853,7 +1078,9 @@ class FleetRouter:
     # -- streaming proxy (journal + mid-stream failover) ---------------------
     def _forward_stream(self, handler: _RouterHandler, path: str,
                         body: bytes, request_id: str, tenant_name: str,
-                        budget_ts: Optional[float]) -> None:
+                        budget_ts: Optional[float],
+                        pool: Optional[str] = None,
+                        prefer: Optional[str] = None) -> None:
         try:
             _FP_ROUTE.fire()
         except _faults.InjectedFault as e:
@@ -882,7 +1109,8 @@ class FleetRouter:
                 else:
                     self._budget_died(handler, request_id)
                 return
-            replica = self._pick(exclude)
+            replica = self._pick(exclude, pool=pool, prefer=prefer)
+            prefer = None    # only the first attempt gets the KV pin
             if replica is None:
                 self._takeover_failed(handler, started, request_id,
                                       "no surviving replica to resume on"

@@ -260,8 +260,14 @@ class FairScheduler:
     ``acquire`` must be paired with ``release(tenant)``.
     """
 
-    def __init__(self, capacity_fn: Callable[[], int]):
+    def __init__(self, capacity_fn: Callable[[], int],
+                 capacity_detail_fn: Optional[Callable[[], Dict]] = None):
         self._capacity_fn = capacity_fn
+        # optional breakdown of WHERE the capacity number comes from
+        # (the disagg router: per-pool routable counts — the fleet-wide
+        # budget is per_replica x the NARROWEST pool); surfaced by
+        # :meth:`capacity` into /healthz and /fleet/health
+        self._capacity_detail_fn = capacity_detail_fn
         # a plain Condition (driver.py idiom): the checked-lock factory
         # can't back one, because Condition._is_owned probes with a
         # speculative re-acquire the sentinel would flag
@@ -276,6 +282,18 @@ class FairScheduler:
             return {name: {"active": st.active, "queued": len(st.queue),
                            "virtual_time": round(st.virtual_time, 6)}
                     for name, st in sorted(self._states.items())}
+
+    def capacity(self) -> Dict:
+        """The momentary admission budget and its provenance:
+        ``{"total", "active"}`` plus whatever the capacity-detail hook
+        adds (the disagg router: ``per_replica``, ``routable``, and
+        per-``pools`` routable counts)."""
+        with self._cond:
+            doc = {"total": int(self._capacity_fn()),
+                   "active": self._fleet_active}
+        if self._capacity_detail_fn is not None:
+            doc.update(self._capacity_detail_fn())
+        return doc
 
     # -- admission -----------------------------------------------------------
     def acquire(self, tenant: Tenant,

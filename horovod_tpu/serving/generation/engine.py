@@ -74,7 +74,7 @@ class GenerationEngine:
                  async_depth: Optional[int] = None,
                  prefix_cache: Optional[bool] = None,
                  reload_poll_seconds: Optional[float] = None,
-                 on_step=None):
+                 on_step=None, role: Optional[str] = None):
         cfg = _config.live_config()
         block_size = int(cfg.get(_config.GEN_BLOCK_SIZE)
                          if block_size is None else block_size)
@@ -97,7 +97,7 @@ class GenerationEngine:
             prefill_chunk=prefill_chunk, queue_depth=queue_depth,
             deadline_ms=deadline_ms, eos_id=eos_id,
             vocab_size=model.cfg.vocab_size, async_depth=async_depth,
-            on_step=on_step)
+            on_step=on_step, role=role)
         self._lifecycle.start_poller()    # last: nothing can fail past here
 
     # -- generation ----------------------------------------------------------
@@ -192,6 +192,44 @@ class GenerationEngine:
     def prefix_cache(self) -> bool:
         """Whether automatic prefix caching is active on this engine."""
         return self.allocator.prefix_cache
+
+    @property
+    def role(self) -> str:
+        """This engine's disagg operating mode
+        (``HVD_TPU_DISAGG_ROLE``): prefill | decode | colocated."""
+        return self.batcher.role
+
+    # -- disaggregated KV transfer surface -----------------------------------
+
+    def kv_manifest(self, prompt: Sequence[int]) -> List[str]:
+        """Content-addressed manifest for ``prompt``: chain hashes of
+        its matchable full blocks (pure; identical on every replica
+        sharing the block size)."""
+        return self.batcher.manifest_hashes(prompt)
+
+    def kv_probe(self, hashes: Sequence[str]) -> int:
+        """Blocks of the ``hashes`` chain this engine already holds
+        (longest indexed prefix; side-effect-free — the offer
+        handler's zero-byte-transfer answer)."""
+        return self.allocator.match_probe([str(h) for h in hashes])[0]
+
+    def kv_export(self, hashes: Sequence[str], timeout: float = 30.0):
+        """Serve ``POST /v1/kv/fetch``: read the requested blocks'
+        contents off the pools (scheduler-thread control op). Returns
+        ``(served_hashes, k_np, v_np)``."""
+        return self.batcher.execute(
+            lambda: self.batcher.export_kv_blocks(hashes), timeout=timeout)
+
+    def kv_import(self, hashes: Sequence[str],
+                  payload_hashes: Sequence[str], k_data, v_data,
+                  timeout: float = 30.0):
+        """Serve ``POST /v1/kv/offer``'s admit step: write transferred
+        payloads into pool blocks and register them (remote) in the
+        prefix-cache index (scheduler-thread control op). Returns
+        ``(already_held, imported)``."""
+        return self.batcher.execute(
+            lambda: self.batcher.import_kv_blocks(
+                hashes, payload_hashes, k_data, v_data), timeout=timeout)
 
     def reload(self, step: Optional[int] = None) -> bool:
         """Force a checkpoint hot-reload now (see

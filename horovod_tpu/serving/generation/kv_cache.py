@@ -51,6 +51,8 @@ import hashlib
 import math
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
+import numpy as np
+
 from ... import _locks
 from ... import config as _config
 from ... import metrics as _metrics
@@ -138,6 +140,11 @@ class BlockAllocator:
         self._index: Dict[str, int] = {}    # content hash -> block
         self._hash_of: Dict[int, str] = {}  # indexed block -> its hash
         self._n_shared = 0                  # blocks with refcount >= 2
+        #: blocks whose contents arrived over the disagg KV wire
+        #: (register(..., remote=True)) rather than from local prefill;
+        #: membership is sticky until the block recycles or evicts, so
+        #: admission can attribute prefix-cache hits source=transfer
+        self._remote: set = set()
         #: bumped by :meth:`reset_cache`; sequences record it so a block
         #: filled before a reset (stale params / zeroed pools) is never
         #: registered after one
@@ -233,6 +240,7 @@ class BlockAllocator:
                     h = self._hash_of.pop(b)
                     if self._index.get(h) == b:
                         del self._index[h]
+                    self._remote.discard(b)
                     evicted += 1
                 self._ref[b] = 1
                 out.append(b)
@@ -274,6 +282,7 @@ class BlockAllocator:
                     else:
                         if h is not None:
                             del self._hash_of[b]
+                        self._remote.discard(b)
                         self._free_list.append(b)
                         self._free_set.add(b)
                 else:
@@ -288,12 +297,21 @@ class BlockAllocator:
 
     # -- prefix-cache surface -----------------------------------------
 
-    def register(self, block: int, content_hash: str) -> None:
+    def register(self, block: int, content_hash: str,
+                 remote: bool = False) -> None:
         """Index a live *full* block under its content chain hash so
         future prompts can match it. First registration wins: a hash
         already indexed (or a block already hashed) is left alone, and
         the duplicate block simply recycles on release. No-op with the
-        prefix cache off."""
+        prefix cache off.
+
+        ``remote=True`` marks the block as transfer-imported (its
+        contents arrived over the disagg KV wire instead of local
+        prefill); the flag sticks until the block recycles or evicts
+        and drives the ``source=transfer`` split of the prefix-cache
+        hit metric. A double-import of an already-indexed hash dedups
+        exactly like a local duplicate — first registration wins, the
+        second block recycles."""
         if not self.prefix_cache:
             return
         with self._lock:
@@ -304,6 +322,22 @@ class BlockAllocator:
                     block not in self._hash_of:
                 self._index[content_hash] = block
                 self._hash_of[block] = content_hash
+                if remote:
+                    self._remote.add(block)
+
+    def is_remote(self, block: int) -> bool:
+        """True when ``block``'s contents arrived via KV transfer
+        (``register(..., remote=True)``) and it has not recycled or
+        evicted since."""
+        with self._lock:
+            return block in self._remote
+
+    @property
+    def remote_blocks(self) -> int:
+        """Blocks currently carrying the transfer-imported mark (live
+        or cached)."""
+        with self._lock:
+            return len(self._remote)
 
     def match_probe(self, hashes: Sequence[str]) -> Tuple[int, int]:
         """Side-effect-free length of the longest indexed prefix of
@@ -366,6 +400,7 @@ class BlockAllocator:
             self._cached.clear()
             self._index.clear()
             self._hash_of.clear()
+            self._remote.clear()
             self.cache_gen += 1
             in_use = len(self._ref)
             stats = self._stats_locked()
@@ -390,6 +425,27 @@ def block_bytes(model_cfg, block_size: int) -> int:
     itemsize = jnp.dtype(model_cfg.dtype).itemsize
     return (2 * model_cfg.num_layers * block_size * model_cfg.num_heads
             * model_cfg.head_dim * itemsize)
+
+
+def gather_blocks(k, v, blocks: Sequence[int]):
+    """Materialize the contents of pool ``blocks`` on the host for the
+    disagg KV wire: ``(k_np, v_np)``, each
+    ``(num_layers, len(blocks), block_size, heads, head_dim)`` in the
+    pool dtype. Must run on the scheduler thread (the pools are donated
+    device buffers the scheduler owns)."""
+    idx = list(blocks)
+    return np.asarray(k[:, idx]), np.asarray(v[:, idx])
+
+
+def scatter_blocks(k, v, blocks: Sequence[int], k_data, v_data):
+    """Write transferred block contents into pool slots ``blocks``;
+    returns the new ``(k, v)`` pool arrays (functional ``.at[].set``, so
+    an in-flight decode step's buffers are untouched). Scheduler-thread
+    only, like :func:`gather_blocks`."""
+    idx = list(blocks)
+    dt = k.dtype
+    return (k.at[:, idx].set(np.asarray(k_data, dtype=dt)),
+            v.at[:, idx].set(np.asarray(v_data, dtype=dt)))
 
 
 @functools.lru_cache(maxsize=8)

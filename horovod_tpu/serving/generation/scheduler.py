@@ -113,7 +113,8 @@ from ... import tracing as _tracing
 from ...models.transformer import PagedCache
 from ..batcher import DeadlineExceededError, QueueFullError
 from .kv_cache import (BlockAllocator, BlocksExhaustedError, DecodeState,
-                       SampleParams, chain_hash)
+                       SampleParams, chain_hash, gather_blocks,
+                       scatter_blocks)
 
 _M_TOKENS = _metrics.counter(
     "hvd_tpu_gen_tokens_total",
@@ -135,8 +136,12 @@ _M_PREFIX_HIT = _metrics.counter(
     "hvd_tpu_gen_prefix_cache_hit_tokens_total",
     "Prompt tokens whose KV was served from the prefix cache at "
     "admission (full cached blocks attached to the sequence's table "
-    "instead of being prefilled). Re-admissions after a preemption "
-    "count again, mirroring hvd_tpu_gen_tokens_total{phase='prefill'}.")
+    "instead of being prefilled), split by where the block contents "
+    "came from: source='local' (computed by this replica's own "
+    "prefill) or source='transfer' (imported over the disagg KV wire "
+    "by a /v1/kv/offer). Re-admissions after a preemption count "
+    "again, mirroring hvd_tpu_gen_tokens_total{phase='prefill'}.",
+    labels=("source",))
 _M_PREFIX_MISS = _metrics.counter(
     "hvd_tpu_gen_prefix_cache_miss_tokens_total",
     "Prompt tokens the prefix cache could not serve at admission — "
@@ -212,6 +217,35 @@ DECODE_WIDTH = 2
 _DONE = object()
 _STOP = object()
 _UNSET = object()
+
+
+class _ControlOp:
+    """A callable smuggled through the submission queue to run ON the
+    scheduler thread, between loop iterations. The disagg KV
+    export/import paths need this: the K/V pools are donated device
+    buffers only the scheduler thread may read or replace, so an HTTP
+    handler enqueues the work and blocks on ``done``. A stopped
+    scheduler fails the op instead of running it."""
+
+    __slots__ = ("fn", "done", "result", "error")
+
+    def __init__(self, fn: Callable):
+        self.fn = fn
+        self.done = threading.Event()
+        self.result = None
+        self.error: Optional[BaseException] = None
+
+    def run(self) -> None:
+        try:
+            self.result = self.fn()
+        except BaseException as e:  # noqa: BLE001 — re-raised at execute()
+            self.error = e
+        finally:
+            self.done.set()
+
+    def fail(self, err: BaseException) -> None:
+        self.error = err
+        self.done.set()
 
 
 def _seed_key(seed: int) -> np.ndarray:
@@ -351,8 +385,21 @@ class ContinuousBatcher:
                  eos_id: Optional[int] = None,
                  vocab_size: Optional[int] = None,
                  async_depth: Optional[int] = None,
-                 on_step: Optional[Callable] = None):
+                 on_step: Optional[Callable] = None,
+                 role: Optional[str] = None):
         cfg = _config.live_config()
+        #: disaggregated operating mode (HVD_TPU_DISAGG_ROLE):
+        #: 'colocated' runs prefill + decode as always; 'prefill'
+        #: retires every sequence the moment its prompt is resident
+        #: (blocks registered and parked for export, sampled token
+        #: discarded); 'decode' behaves like colocated — its difference
+        #: is fed transferred blocks via import_kv_blocks
+        self.role = str(cfg.get(_config.DISAGG_ROLE)
+                        if role is None else role).strip().lower()
+        if self.role not in ("prefill", "decode", "colocated"):
+            raise ValueError(
+                f"HVD_TPU_DISAGG_ROLE={self.role!r}: must be one of "
+                f"prefill|decode|colocated")
         self._prefill_prog, self._decode_prog = programs
         self._params_fn = params_fn
         self._k, self._v = pools
@@ -594,6 +641,97 @@ class ContinuousBatcher:
                         seed=seed),
             timeout)
 
+    # -- disaggregated KV export/import --------------------------------------
+
+    def execute(self, fn: Callable, timeout: float = 30.0):
+        """Run ``fn`` on the scheduler thread between loop iterations
+        and return its result (re-raising its exception). The K/V pools
+        are donated device buffers with scheduler-thread affinity —
+        every disagg export/import goes through here so an HTTP worker
+        never races the decode pipeline for them."""
+        op = _ControlOp(fn)
+        self._ensure_thread()
+        self._q.put(op, timeout=timeout)
+        if self._stopped:
+            self._drain_failed(RuntimeError("generation scheduler stopped"))
+        if not op.done.wait(timeout):
+            raise TimeoutError(
+                "scheduler control op not serviced in time")
+        if op.error is not None:
+            raise op.error
+        return op.result
+
+    def manifest_hashes(self, tokens: Sequence[int]) -> List[str]:
+        """The content-addressed manifest for ``tokens``: chain hashes
+        of its matchable full blocks (pure computation — identical on
+        every replica with the same block size)."""
+        return self._prefix_hashes_for([int(t) for t in tokens])
+
+    def export_kv_blocks(self, hashes: Sequence[str]):
+        """Scheduler-thread body of ``POST /v1/kv/fetch`` (call via
+        :meth:`execute`): pin the longest indexed prefix of ``hashes``,
+        read those blocks' contents off the pools, release. Returns
+        ``(served_hashes, k_np, v_np)`` — a prefix of the request (the
+        tail may have evicted since the manifest was minted; the decode
+        side re-prefills whatever is missing)."""
+        hashes = [str(h) for h in hashes]
+        if not self._prefix_cache:
+            return [], None, None
+        held = self._alloc.match(hashes)
+        if not held:
+            return [], None, None
+        try:
+            k_np, v_np = gather_blocks(self._k, self._v, held)
+        finally:
+            self._alloc.free(held)
+        return hashes[:len(held)], k_np, v_np
+
+    def import_kv_blocks(self, hashes: Sequence[str],
+                         payload_hashes: Sequence[str],
+                         k_data, v_data) -> Tuple[int, int]:
+        """Scheduler-thread body of ``POST /v1/kv/offer`` (call via
+        :meth:`execute`): register transferred block payloads into the
+        local prefix cache so the next admission of the matching prompt
+        attaches them with zero full-block prefill debt. ``hashes`` is
+        the full chain manifest; ``payload_hashes``/``k_data``/
+        ``v_data`` cover the blocks the source shipped (any order,
+        matched by hash). Returns ``(already_held, imported)`` block
+        counts. The already-held chain prefix is pinned across the
+        allocation so eviction can never tear a hole in it; imported
+        blocks are registered ``remote=True`` and parked cached —
+        a double-import of the same hash dedups via first-registration-
+        wins and the duplicate simply recycles."""
+        hashes = [str(h) for h in hashes]
+        if not self._prefix_cache or not hashes:
+            return 0, 0
+        held = self._alloc.match(hashes)
+        m = len(held)
+        pos = {str(h): i for i, h in enumerate(payload_hashes or [])}
+        want: List[Tuple[str, int]] = []
+        for j in range(m, len(hashes)):
+            i = pos.get(hashes[j])
+            if i is None:
+                break       # chain broken: a gap is un-attachable
+            want.append((hashes[j], i))
+        fresh: List[int] = []
+        if want:
+            try:
+                fresh = self._alloc.allocate(len(want))
+            except BlocksExhaustedError:
+                # pool pressure beats the transfer: the admission path
+                # re-prefills instead — never preempt running work for
+                # speculative cache warmth
+                self._alloc.free(held)
+                return m, 0
+            idx = [i for _, i in want]
+            self._k, self._v = scatter_blocks(
+                self._k, self._v, fresh,
+                np.asarray(k_data)[:, idx], np.asarray(v_data)[:, idx])
+            for b, (h, _) in zip(fresh, want):
+                self._alloc.register(b, h, remote=True)
+        self._alloc.free(held + fresh)
+        return m, len(fresh)
+
     # -- lifecycle -----------------------------------------------------------
 
     def _ensure_thread(self) -> None:
@@ -653,7 +791,10 @@ class ContinuousBatcher:
                     if item is not _STOP and item is not None:
                         self._deliver_error(item, err)
                     break
-                self._waiting.append(item)
+                if isinstance(item, _ControlOp):
+                    item.run()
+                else:
+                    self._waiting.append(item)
             while True:
                 try:
                     item = self._q.get_nowait()
@@ -662,6 +803,9 @@ class ContinuousBatcher:
                 if item is _STOP:
                     self._shutdown(err)
                     return
+                if isinstance(item, _ControlOp):
+                    item.run()
+                    continue
                 self._waiting.append(item)
             if self._stopped:
                 self._shutdown(err)
@@ -797,7 +941,16 @@ class ContinuousBatcher:
                 s.block_hashes = list(s.prefix_hashes[:len(s.blocks)])
                 s.prefilled = len(s.blocks) * self._alloc.block_size
                 s.cache_len = s.prefilled
-                _M_PREFIX_HIT.inc(s.prefilled)
+                # hit attribution: a block whose contents arrived over
+                # the disagg KV wire counts source=transfer until it
+                # recycles; everything else was local prefill work
+                bs = self._alloc.block_size
+                transfer = sum(bs for b in s.blocks
+                               if self._alloc.is_remote(b))
+                if transfer:
+                    _M_PREFIX_HIT.labels(source="transfer").inc(transfer)
+                _M_PREFIX_HIT.labels(source="local").inc(
+                    s.prefilled - transfer)
                 _M_PREFIX_MISS.inc(len(s.prefill_tokens) - s.prefilled)
             s.cache_gen = self._alloc.cache_gen
             self._running.append(s)
@@ -874,6 +1027,19 @@ class ContinuousBatcher:
         s.prefilled += live
         s.cache_len = s.prefilled
         self._register_full_blocks(s)
+        if s.prefilled == total and self.role == "prefill":
+            # prefill-only operating mode: the prompt's KV is resident
+            # and its full blocks are registered — retiring now parks
+            # them (contents intact, content-indexed) in the cached-free
+            # pool, which IS the export staging area for /v1/kv/fetch.
+            # The final chunk's sampled token is deliberately discarded:
+            # the decode pool samples it itself from the identical
+            # cache state, which is what keeps disaggregated output
+            # bit-identical to colocated.
+            self._retire(s, device_synced=True)
+            if self.on_step is not None:
+                self.on_step("prefill", [s.id])
+            return
         if s.prefilled == total:
             s.state = "decode"
             self._epoch += 1        # a new lane joins the decode batch
@@ -1275,7 +1441,11 @@ class ContinuousBatcher:
         s.stream_q.put(_DONE)
         s.done_event.set()
 
-    def _deliver_error(self, s: GenSequence, err: BaseException) -> None:
+    def _deliver_error(self, s, err: BaseException) -> None:
+        if isinstance(s, _ControlOp):
+            # a control op drained by stop()/shutdown: fail its waiter
+            s.fail(err)
+            return
         if s.state == "done":
             # completed (or already failed) while the error was brewing
             # — e.g. retired by a drained in-flight step; its outcome

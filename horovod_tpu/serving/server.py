@@ -76,6 +76,8 @@ from .. import metrics as _metrics
 from .. import tracing as _tracing
 from .batcher import (DEADLINE_HEADER, DEADLINE_STAGE_HEADER,
                       DeadlineExceededError, QueueFullError)
+from .disagg.transfer import pull_and_import
+from .disagg.wire import pack_blocks
 from .engine import InferenceEngine
 from .generation.scheduler import RequestCancelledError
 
@@ -162,6 +164,9 @@ class _ServingHandler(_http.QuietHandler):
             alloc = self.server.gen_engine.allocator
             doc["prefix_cache"] = bool(alloc.prefix_cache)
             doc["kv_blocks"] = alloc.stats()
+            # pool membership for the disagg fleet: the router's
+            # /fleet/health aggregates this per pool
+            doc["disagg_role"] = self.server.gen_engine.role
         self._respond(200, doc)
 
     def do_POST(self):  # noqa: N802
@@ -175,6 +180,10 @@ class _ServingHandler(_http.QuietHandler):
             self._generate_stream()
         elif path == "/v1/cancel":
             self._cancel()
+        elif path == "/v1/kv/offer":
+            self._kv_offer()
+        elif path == "/v1/kv/fetch":
+            self._kv_fetch()
         elif path == "/v1/reload":
             self._reload()
         else:
@@ -324,10 +333,19 @@ class _ServingHandler(_http.QuietHandler):
                             "(request %s): %s", self._request_id(), e)
                 self._respond(500, {"error": str(e)})
                 return
-            self._respond(200, {"tokens": tokens,
-                                "logprobs": [round(x, 6)
-                                             for x in seq.logprobs],
-                                "step": gen.step})
+            out = {"tokens": tokens,
+                   "logprobs": [round(x, 6) for x in seq.logprobs],
+                   "step": gen.step}
+            if gen.role == "prefill":
+                # prefill-only replica: no tokens come back — the
+                # deliverable is the content-addressed manifest the
+                # router offers to the decode pool, plus where to
+                # fetch the payloads from
+                out["manifest"] = {
+                    "hashes": gen.kv_manifest(kwargs["prompt"]),
+                    "source": getattr(self.server, "advertised_url",
+                                      None)}
+            self._respond(200, out)
 
     def _generate_stream(self) -> None:
         """NDJSON streaming generation (module docstring: wire format).
@@ -434,6 +452,78 @@ class _ServingHandler(_http.QuietHandler):
         gen.cancel(rid)
         self._respond(200, {"cancelled": rid})
 
+    # -- disaggregated KV hop (docs/inference.md: disaggregation) ------------
+
+    def _kv_offer(self) -> None:
+        """Decode side of the KV hop: the router offers a prompt's
+        content-addressed manifest; this replica pulls only the blocks
+        it doesn't already hold from the named prefill source and
+        registers them for zero-debt admission. Transfer failures
+        degrade (``error`` in the 200 body) — the only client error
+        here is an already-exhausted end-to-end budget, shed as a 429
+        attributed to the ``transfer`` stage."""
+        gen = self.server.gen_engine
+        if gen is None:
+            self._respond(404, {"error": "no generation engine configured"})
+            return
+        try:
+            doc = self._read_doc()
+            hashes = [str(h) for h in doc["hashes"]]
+            source = doc.get("source")
+            budget_ms = self._budget_ms()
+        except (ValueError, KeyError, TypeError) as e:
+            self._respond(400, {"error": f"bad request: {e}"})
+            return
+        if budget_ms is not None and budget_ms <= 0:
+            self._deadline_exceeded(DeadlineExceededError(
+                "end-to-end budget exhausted before KV transfer",
+                stage="transfer"))
+            return
+        with _tracing.request_span(
+                "server.kv_offer", self._request_id(),
+                parent=self.headers.get(_tracing.TRACE_PARENT_HEADER),
+                args={"blocks": len(hashes)}):
+            res = pull_and_import(gen, hashes, source=source,
+                                  request_id=self._request_id())
+        self._respond(200, res)
+
+    def _kv_fetch(self) -> None:
+        """Prefill side of the KV hop: read the requested blocks'
+        contents off the paged pools (scheduler-thread control op) and
+        ship them packed. Blocks evicted since the offer simply
+        truncate the served prefix — the decode side re-prefills the
+        difference."""
+        gen = self.server.gen_engine
+        if gen is None:
+            self._respond(404, {"error": "no generation engine configured"})
+            return
+        try:
+            doc = self._read_doc()
+            hashes = [str(h) for h in doc["hashes"]]
+            wire_dtype = str(
+                doc.get("wire_dtype")
+                or _config.live_config().get(
+                    _config.DISAGG_WIRE_DTYPE)).strip().lower()
+        except (ValueError, KeyError, TypeError) as e:
+            self._respond(400, {"error": f"bad request: {e}"})
+            return
+        with _tracing.request_span(
+                "server.kv_fetch", self._request_id(),
+                parent=self.headers.get(_tracing.TRACE_PARENT_HEADER),
+                args={"blocks": len(hashes)}):
+            try:
+                served, k_np, v_np = gen.kv_export(hashes)
+                payload = pack_blocks(served, k_np, v_np, wire_dtype)
+            except ValueError as e:        # unknown wire dtype
+                self._respond(400, {"error": str(e)})
+                return
+            except Exception as e:  # noqa: BLE001 — export failure -> 500
+                log.warning("serving: KV export failed (request %s): %s",
+                            self._request_id(), e)
+                self._respond(500, {"error": str(e)})
+                return
+            self._respond(200, payload)
+
 
 class InferenceServer:
     """HTTP front-end over an :class:`InferenceEngine` and/or
@@ -451,7 +541,7 @@ class InferenceServer:
     def __init__(self, engine: Optional[InferenceEngine],
                  port: Optional[int] = None,
                  addr: str = "0.0.0.0", verbose: bool = False,
-                 gen_engine=None):
+                 gen_engine=None, advertised_url: Optional[str] = None):
         if engine is None and gen_engine is None:
             raise ValueError(
                 "provide at least one of engine= / gen_engine=")
@@ -463,6 +553,10 @@ class InferenceServer:
         self._addr = addr
         self._verbose = verbose
         self._httpd = None
+        # the URL OTHER replicas reach this server at — a prefill
+        # replica hands it out as the manifest's fetch source (defaults
+        # to loopback + the bound port, right for single-host fleets)
+        self._advertised_url = advertised_url
 
     @property
     def port(self) -> int:
@@ -478,6 +572,9 @@ class InferenceServer:
                 verbose=self._verbose)
             self._httpd.engine = self.engine
             self._httpd.gen_engine = self.gen_engine
+            self._httpd.advertised_url = (
+                self._advertised_url
+                or f"http://127.0.0.1:{self.port}")
             log.info("serving: HTTP front-end on %s:%d (step %d)",
                      self._addr, self.port,
                      (self.engine or self.gen_engine).step)

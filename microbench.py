@@ -1,7 +1,7 @@
 #!/usr/bin/env python
 """Collective-plane microbenchmark driver (VERDICT r3 item 2).
 
-Runs eight sections, each in killable CPU subprocesses, and writes
+Runs nine sections, each in killable CPU subprocesses, and writes
 ``MICROBENCH.json``:
 
 1. ``eager_1proc``  — payload sweep of the eager plane with one process:
@@ -55,10 +55,19 @@ Runs eight sections, each in killable CPU subprocesses, and writes
    resumed first token, automatic prefix cache on vs off, with the
    resumed stream asserted bit-identical under seeded sampling).
 
+9. ``disagg``       — disaggregated prefill/decode serving
+   (docs/inference.md) vs colocated, end to end through real HTTP
+   fleets on the shared-system-prompt mixed workload: tokens/sec and
+   per-request p50/p99 for a 2-colocated-replica fleet vs a
+   1-prefill + 1-decode pooled fleet, with outputs asserted
+   bit-identical across modes, the pooled KV-transfer bytes/seconds
+   recorded, and a fully-warm repeat request asserted to move ZERO
+   transfer bytes (the content-addressed dedup acceptance number).
+
 Usage: ``python microbench.py [--quick]``. Workers are internal
 (``--worker-eager`` / ``--worker-scaling`` / ``--worker-injit`` /
 ``--worker-generation`` / ``--worker-sdc`` / ``--worker-tracing`` /
-``--worker-failover``).
+``--worker-failover`` / ``--worker-disagg``).
 """
 
 import json
@@ -313,6 +322,32 @@ def _run_failover(quick: bool, timeout: int):
     return rows or None
 
 
+def worker_disagg(quick: bool) -> int:
+    from horovod_tpu.microbench import disagg_sweep
+    row = disagg_sweep(num_requests=8 if quick else 16,
+                       batch_slots=4 if quick else 8)
+    print(MB_TAG + json.dumps(row))
+    return 0
+
+
+def _run_disagg(quick: bool, timeout: int):
+    cmd = [sys.executable, os.path.abspath(__file__), "--worker-disagg"]
+    if quick:
+        cmd.append("--quick")
+    try:
+        p = subprocess.run(cmd, env=_cpu_env(), text=True,
+                           capture_output=True, timeout=timeout)
+    except subprocess.TimeoutExpired:
+        _log("disagg: timeout")
+        return None
+    sys.stderr.write(p.stderr or "")
+    if p.returncode != 0:
+        _log(f"disagg: rc={p.returncode}")
+        return None
+    rows = _collect(p.stdout or "")
+    return rows[0] if rows else None
+
+
 def _run_injit(n: int, quick: bool, timeout: int):
     env = _cpu_env({
         "XLA_FLAGS": f"--xla_force_host_platform_device_count={n}",
@@ -353,6 +388,8 @@ def main():
             return worker_tracing(quick)
         if a == "--worker-failover":
             return worker_failover(quick)
+        if a == "--worker-disagg":
+            return worker_disagg(quick)
 
     t0 = time.time()
     result = {"quick": quick}
@@ -364,15 +401,15 @@ def main():
         bk = next((r for r in rows if "scenario" in r), None)
         return plain, bk
 
-    _log("section 1/8: eager sweep, 1 process")
+    _log("section 1/9: eager sweep, 1 process")
     result["eager_1proc"], result["bucketed_1proc"] = split_bucketed(
         _run_eager(1, quick, timeout=600))
 
-    _log("section 2/8: eager sweep, 2 processes")
+    _log("section 2/9: eager sweep, 2 processes")
     result["eager_2proc"], result["bucketed_2proc"] = split_bucketed(
         _run_eager(2, quick, timeout=900))
 
-    _log("section 3/8: compiled-plane scaling sweep")
+    _log("section 3/9: compiled-plane scaling sweep")
     points = []
     for n in (1, 2, 4, 8):
         row = _run_scaling(n, quick, timeout=600)
@@ -387,7 +424,7 @@ def main():
                 / (p["num_devices"] * base["images_per_sec_total"]), 3)
     result["scaling"] = points
 
-    _log("section 4/8: in-jit fast path (ResNet-50 gradient scenario)")
+    _log("section 4/9: in-jit fast path (ResNet-50 gradient scenario)")
     injit_rows = []
     for n in ((1, 2) if quick else (1, 2, 8)):
         row = _run_injit(n, quick, timeout=900)
@@ -409,7 +446,7 @@ def main():
                  f"(x{row['packed_speedup_vs_per_leaf']} vs per-leaf)")
     result["injit"] = injit_rows
 
-    _log("section 5/8: continuous vs static batch generation + sampling")
+    _log("section 5/9: continuous vs static batch generation + sampling")
     gen_rows = _run_generation(quick, timeout=1200)
     gen = gen_rows[0] if gen_rows else None
     sampling = gen_rows[1] if gen_rows and len(gen_rows) > 1 else None
@@ -435,7 +472,7 @@ def main():
     result["generation_sampling"] = sampling
     result["generation_prefix"] = prefix
 
-    _log("section 6/8: SDC guard + fingerprint overhead")
+    _log("section 6/9: SDC guard + fingerprint overhead")
     sdc = _run_sdc(quick, timeout=600)
     if sdc:
         _log(f"  guard on/off: {sdc['guarded_ms_per_step']} vs "
@@ -446,7 +483,7 @@ def main():
              f"{sdc['fingerprint_every']} steps")
     result["sdc"] = sdc
 
-    _log("section 7/8: per-request tracing overhead")
+    _log("section 7/9: per-request tracing overhead")
     tracing_row = _run_tracing(quick, timeout=300)
     if tracing_row:
         _log(f"  off {tracing_row['off_us_per_req']} us/req over bare "
@@ -456,7 +493,7 @@ def main():
              f"(+{tracing_row['on_overhead_us_per_req']} us traced)")
     result["tracing"] = tracing_row
 
-    _log("section 8/8: request survivability (hedging tail + resume cost)")
+    _log("section 8/9: request survivability (hedging tail + resume cost)")
     fo_rows = _run_failover(quick, timeout=900)
     hedging = fo_rows[0] if fo_rows else None
     resume = fo_rows[1] if fo_rows and len(fo_rows) > 1 else None
@@ -474,6 +511,19 @@ def main():
              f"{resume['bit_identical']})")
     result["failover"] = ({"hedging": hedging, "resume": resume}
                           if fo_rows else None)
+
+    _log("section 9/9: disaggregated prefill/decode fleet")
+    disagg = _run_disagg(quick, timeout=900)
+    if disagg:
+        _log(f"  pooled {disagg['pooled']['tokens_per_s']} tok/s "
+             f"p99 {disagg['pooled']['p99_ms']} ms vs colocated "
+             f"{disagg['colocated']['tokens_per_s']} tok/s "
+             f"p99 {disagg['colocated']['p99_ms']} ms, "
+             f"{disagg['pooled']['transfer_bytes']} transfer bytes "
+             f"(warm repeat "
+             f"{disagg['pooled']['warm_repeat_transfer_bytes']}), "
+             f"bit_identical={disagg['bit_identical']}")
+    result["disagg"] = disagg
     result["wall_s"] = round(time.time() - t0, 1)
 
     out_path = os.path.join(ROOT, "MICROBENCH.json")
@@ -524,6 +574,14 @@ def main():
         "resume_first_token_ms_cached": resume
         ["resume_first_token_ms_cache_on"] if resume else None,
         "resume_bit_identical": resume["bit_identical"] if resume else None,
+        "disagg_pooled_tokens_per_s": disagg["pooled"]["tokens_per_s"]
+        if disagg else None,
+        "disagg_pooled_p99_ms": disagg["pooled"]["p99_ms"]
+        if disagg else None,
+        "disagg_warm_transfer_bytes": disagg["pooled"]
+        ["warm_repeat_transfer_bytes"] if disagg else None,
+        "disagg_bit_identical": disagg["bit_identical"]
+        if disagg else None,
     }))
     return 0
 

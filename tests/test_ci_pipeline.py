@@ -227,6 +227,27 @@ def test_generation_suite_is_seeded_and_exclusive():
         assert os.path.exists(os.path.join(root, *fname.split("/")))
 
 
+def test_disagg_suite_is_seeded_and_exclusive():
+    """The disaggregated-serving suite (KV-block wire codec, allocator
+    export/import round trips, pool-split fleet bit-parity, zero-byte
+    warm transfers, the transfer deadline stage, and the seeded
+    disagg.transfer mid-transfer kill drill) runs seeded as its own CI
+    suite; the generic unit and chaos suites must not run the file
+    twice, and the colocated fleet suites stay scoped to their own
+    files."""
+    by_name = {name: cmd for name, cmd, _t in COMMON_SUITES}
+    assert "serving-disagg" in by_name
+    cmd = by_name["serving-disagg"]
+    assert "HVD_TPU_FAULT_SEED=" in cmd
+    assert "tests/test_disagg.py" in cmd
+    assert "--ignore=tests/test_disagg.py" in by_name["unit"]
+    assert "--ignore=tests/test_disagg.py" in by_name["chaos"]
+    assert "tests/test_disagg.py" not in by_name["serving-fleet"]
+    assert "tests/test_disagg.py" not in by_name["chaos-fleet-failover"]
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    assert os.path.exists(os.path.join(root, "tests", "test_disagg.py"))
+
+
 def test_chaos_sdc_suite_is_seeded_and_exclusive():
     """The silent-data-corruption drills (step guard, fingerprints,
     skip/rollback/quarantine policy, 2-proc bitflip e2e drill) run as

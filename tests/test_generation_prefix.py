@@ -36,7 +36,10 @@ CFG = TransformerConfig(vocab_size=64, num_layers=2, d_model=32,
                         num_heads=2, head_dim=16, max_seq_len=64,
                         dtype=jnp.float32)
 
-HIT = "hvd_tpu_gen_prefix_cache_hit_tokens_total"
+# admission hits split by where the KV came from; everything in this
+# suite exercises the local path (the disagg transfer path is
+# tests/test_disagg.py's)
+HIT = 'hvd_tpu_gen_prefix_cache_hit_tokens_total{source="local"}'
 MISS = "hvd_tpu_gen_prefix_cache_miss_tokens_total"
 EVICTIONS = "hvd_tpu_gen_prefix_cache_evictions_total"
 PREFILL = 'hvd_tpu_gen_tokens_total{phase="prefill"}'
@@ -212,11 +215,15 @@ class TestAllocatorPrefixCache:
         assert a.match_probe(hs) == (0, 0)
 
     def test_randomized_allocator_invariants(self):
-        """Property test over random allocate/match/free/reset traffic:
-        refcounts track live table membership exactly (never negative,
-        shared iff >= 2 tables), free+cached+in_use == num_blocks-1 at
-        every step, allocation never hands out a block a live table
-        still references, and the null block never escapes."""
+        """Property test over random allocate/match/free/reset traffic,
+        with disagg remote registration mixed in: refcounts track live
+        table membership exactly (never negative, shared iff >= 2
+        tables), free+cached+in_use == num_blocks-1 at every step,
+        allocation never hands out a block a live table still
+        references, the null block never escapes, transfer-imported
+        marks only ever sit on non-free blocks, and a double-import of
+        an already-indexed hash dedups (first registration wins, the
+        duplicate recycles plain)."""
         rng = np.random.RandomState(SEED)
         a = BlockAllocator(num_blocks=17, block_size=2, prefix_cache=True)
         # a small prompt pool makes matches and sharing frequent
@@ -224,7 +231,7 @@ class TestAllocatorPrefixCache:
         tables = {}
         next_id = 0
         for _step in range(400):
-            op = rng.randint(0, 10)
+            op = rng.randint(0, 12)
             if op < 5:
                 toks = prompts[rng.randint(len(prompts))]
                 hs = _hashes(toks, 2)
@@ -237,11 +244,43 @@ class TestAllocatorPrefixCache:
                 else:
                     held = {blk for t in tables.values() for blk in t}
                     assert not set(fresh) & held
+                    # half the traffic registers transfer-imported (the
+                    # decode replica's KV-import path): the remote mark
+                    # must not disturb any refcount/LRU invariant below
+                    remote = bool(rng.randint(2))
                     for j, blk in enumerate(fresh):
-                        a.register(blk, hs[len(matched) + j])
+                        a.register(blk, hs[len(matched) + j],
+                                   remote=remote)
                     tables[next_id] = matched + fresh
                     next_id += 1
-            elif op < 9 and tables:
+            elif op == 5:
+                # double-import: re-register an already-indexed hash
+                # from a freshly allocated block — the index must not
+                # move, the duplicate must not take the remote mark,
+                # and freeing it recycles (not parks) it
+                toks = prompts[rng.randint(len(prompts))]
+                hs = _hashes(toks, 2)
+                probe = a.match_probe(hs)[0]
+                dup = []
+                if probe:
+                    try:
+                        dup = a.allocate(1)
+                    except BlocksExhaustedError:
+                        dup = []
+                if dup:
+                    # allocate(1) may itself have evicted the probed
+                    # block; the dedup claim only holds when the hash
+                    # is still indexed
+                    if a.match_probe(hs)[0] == probe:
+                        a.register(dup[0], hs[0], remote=True)
+                        assert not a.is_remote(dup[0])
+                        assert a.match_probe(hs)[0] == probe
+                        cached_before = a.cached_blocks
+                        a.free(dup)
+                        assert a.cached_blocks == cached_before
+                    else:
+                        a.free(dup)
+            elif op < 10 and tables:
                 tid = list(tables)[rng.randint(len(tables))]
                 a.free(tables.pop(tid))
             else:
@@ -259,6 +298,10 @@ class TestAllocatorPrefixCache:
                 assert a.refcount(blk) == c
             assert sum(1 for c in counts.values() if c >= 2) \
                 == st["shared"]
+            # a remote mark on a free-listed block would mis-attribute
+            # a future admission's hit source
+            assert a.remote_blocks <= \
+                st["cached"] + st["private"] + st["shared"]
         for t in tables.values():
             a.free(t)
         assert a.in_use == 0
