@@ -618,6 +618,37 @@ GEN_PREFIX_CACHE = _register(
          "partial tail block stays private), so cached-prefix decode "
          "is bit-identical to cold decode. Set to 0 to restore the "
          "recycle-immediately allocator.")
+GEN_SPEC_MODE = _register(
+    "GEN_SPEC_MODE", "off", str,
+    help="Speculative decoding for the generation plane: 'off' runs "
+         "the plain one-token decode loop; 'ngram' drafts by suffix-"
+         "matching the sequence's own prompt + emitted tokens (zero "
+         "extra model); 'draft' rolls a small draft model forward on "
+         "the host (the engine's draft_model/draft_params arguments). "
+         "Drafted tokens are verified in one paged forward per step "
+         "and the accepted prefix is exactly what the plain decoder "
+         "would have produced, so speculative output is bit-identical "
+         "to non-speculative for greedy AND seeded sampling, logprobs "
+         "included — the knob trades nothing but compute shape.")
+GEN_SPEC_TOKENS = _register(
+    "GEN_SPEC_TOKENS", 4, int,
+    help="Draft width for speculative decoding: tokens proposed (and "
+         "scored in one paged verify forward) per lane per step. "
+         "Static — it sizes the compiled verify program's chunk "
+         "(width draft+1), so changing it recompiles. Higher widths "
+         "pay off only when the proposer's accept rate is high "
+         "(hvd_tpu_gen_spec_accepted_total / _drafted_total); rejected "
+         "draft positions are wasted compute, never cache corruption "
+         "(their K/V writes are rolled back through the null block).")
+GEN_BEAMS = _register(
+    "GEN_BEAMS", 4, int,
+    help="Maximum beam width the generation plane accepts per request "
+         "(the num_beams API field; 1 = beam search disabled for the "
+         "request). Static — it sizes the compiled beam step's top-k "
+         "width. Beams share their common prefix KV blocks through "
+         "the refcounted prefix-cache substrate and copy-on-extend "
+         "only the divergent tail block; num_beams=1 output is "
+         "bit-identical to plain greedy decode.")
 
 # -- Serving fleet (no reference equivalent — serving/fleet/: the router
 #    tier over N replica servers: health-aware balancing, per-tenant

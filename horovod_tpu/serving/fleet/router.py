@@ -158,7 +158,7 @@ class _Replica:
 
     __slots__ = ("id", "base_url", "pool", "outstanding", "draining",
                  "hb_dead", "circuit_open", "failure_streak",
-                 "probe_attempt", "next_probe_at")
+                 "probe_attempt", "next_probe_at", "capabilities")
 
     def __init__(self, replica_id: str, base_url: str,
                  pool: str = "colocated"):
@@ -167,6 +167,12 @@ class _Replica:
         # disagg pool membership: "prefill" | "decode" | "colocated"
         # (a colocated replica serves BOTH pools)
         self.pool = pool
+        #: feature advertisement carried on the replica's heartbeats
+        #: (spec_mode / spec_tokens / max_beams, ...): lets operators
+        #: assert a decode pool homogeneous from /fleet/health before
+        #: prestaging spec or beam traffic onto it. None until the
+        #: first capability-bearing beat arrives.
+        self.capabilities: Optional[dict] = None
         self.outstanding = 0
         self.draining = False
         self.hb_dead = False
@@ -208,7 +214,19 @@ class _RouterHandler(_http.QuietHandler):
         path = self.path.split("?", 1)[0]
         if path.startswith(HEARTBEAT_PATH):
             replica_id = path[len(HEARTBEAT_PATH):]
-            if self.server.router.observe_beat(replica_id):
+            # the beat body is an optional JSON capability document
+            # (spec/beam enablement etc.); plain liveness beats carry
+            # an opaque placeholder and leave capabilities untouched
+            caps = None
+            try:
+                raw = self.rfile.read(
+                    int(self.headers.get("Content-Length", 0)))
+                doc = json.loads(raw) if raw.strip() else None
+                if isinstance(doc, dict):
+                    caps = doc
+            except (ValueError, TypeError):
+                pass
+            if self.server.router.observe_beat(replica_id, caps):
                 self._send(200, {"ok": True})
             else:
                 self._send(404, {"error": f"unknown replica {replica_id!r}"})
@@ -421,7 +439,8 @@ class FleetRouter:
             replicas = {r.id: {"state": r.state(),
                                "pool": r.pool,
                                "outstanding": r.outstanding,
-                               "url": r.base_url}
+                               "url": r.base_url,
+                               "capabilities": r.capabilities}
                         for r in self._replicas.values()}
             routable = self._routable_count
             effective = self._effective_routable()
@@ -437,9 +456,13 @@ class FleetRouter:
             doc["pools"] = pool_routable
         return doc
 
-    def observe_beat(self, replica_id: str) -> bool:
+    def observe_beat(self, replica_id: str,
+                     capabilities: Optional[dict] = None) -> bool:
         if replica_id not in self._replicas:
             return False
+        if capabilities is not None:
+            with self._lock:
+                self._replicas[replica_id].capabilities = capabilities
         self.monitor.observe_key(replica_id, meta=replica_id)
         return True
 
@@ -1375,15 +1398,20 @@ class _RouterBeatClient:
     error here drops the beat on the floor (the silent-replica
     simulation) — the sender treats it like any delivery failure."""
 
-    def __init__(self, router_url: str, timeout: float = 2.0):
+    def __init__(self, router_url: str, timeout: float = 2.0,
+                 payload: Optional[bytes] = None):
         self._url = router_url.rstrip("/")
         self._timeout = timeout
+        # optional JSON capability document carried on every beat
+        # (spec/beam enablement): the router stores it per replica and
+        # republishes it on /fleet/health
+        self._payload = payload
 
     def put(self, scope: str, key: str, value: bytes) -> None:
         _FP_HEALTH.fire()
         req = urllib.request.Request(
-            self._url + HEARTBEAT_PATH + key, data=value or b"-",
-            method="POST")
+            self._url + HEARTBEAT_PATH + key,
+            data=self._payload or value or b"-", method="POST")
         with urllib.request.urlopen(req, timeout=self._timeout):
             pass
 
@@ -1395,14 +1423,17 @@ class ReplicaHeartbeat:
     at the router instead of the rendezvous store)."""
 
     def __init__(self, router_url: str, replica_id: str,
-                 interval: Optional[float] = None):
+                 interval: Optional[float] = None,
+                 capabilities: Optional[dict] = None):
         if interval is None:
             interval = float(_config.live_config().get(
                 _config.FLEET_HEARTBEAT_INTERVAL))
+        payload = (json.dumps(capabilities).encode("utf-8")
+                   if capabilities else None)
         self._sender = HeartbeatSender(
-            _RouterBeatClient(router_url), hostname=replica_id,
-            local_rank=0, rank=replica_id, interval=interval,
-            key=replica_id)
+            _RouterBeatClient(router_url, payload=payload),
+            hostname=replica_id, local_rank=0, rank=replica_id,
+            interval=interval, key=replica_id)
 
     def beat_once(self) -> bool:
         return self._sender.beat_once()

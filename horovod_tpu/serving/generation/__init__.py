@@ -23,7 +23,14 @@ same admission machinery:
   in-flight device step;
 * :mod:`.engine` — :class:`GenerationEngine`: the scheduler glued to
   the shared checkpoint restore + hot-reload lifecycle
-  (:class:`~horovod_tpu.serving.engine.ParamsLifecycle`).
+  (:class:`~horovod_tpu.serving.engine.ParamsLifecycle`);
+* :mod:`.spec` — speculative decoding proposers
+  (``HVD_TPU_GEN_SPEC_MODE``): n-gram self-drafting or a small draft
+  model, verified k-at-a-time by :func:`build_verify_program` with
+  output bit-identical to plain decode; beam search
+  (``num_beams`` at submit, capped by ``HVD_TPU_GEN_BEAMS``) rides the
+  same paged cache via :func:`build_beam_program` with
+  copy-on-extend block forking.
 
 Quick start::
 
@@ -44,7 +51,10 @@ See docs/inference.md for architecture, knobs, metrics, and drills.
 from .engine import GenerationEngine                        # noqa: F401
 from .kv_cache import (BlockAllocator, BlocksExhaustedError,  # noqa: F401
                        DecodeState, SampleParams, block_bytes,
-                       build_decode_program, build_prefill_program,
-                       build_program, chain_hash, make_pools,
+                       build_beam_program, build_decode_program,
+                       build_prefill_program, build_program,
+                       build_verify_program, chain_hash, make_pools,
                        sample_tokens)
 from .scheduler import ContinuousBatcher, GenSequence       # noqa: F401
+from .spec import (DraftModelProposer, NGramProposer,       # noqa: F401
+                   Proposer, make_proposer)

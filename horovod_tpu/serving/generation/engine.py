@@ -27,9 +27,11 @@ from typing import Any, List, Optional, Sequence
 
 from ... import config as _config
 from ..engine import ParamsLifecycle
-from .kv_cache import (BlockAllocator, build_decode_program,
-                       build_prefill_program, make_pools)
+from .kv_cache import (BlockAllocator, build_beam_program,
+                       build_decode_program, build_prefill_program,
+                       build_verify_program, make_pools)
 from .scheduler import DECODE_WIDTH, ContinuousBatcher, GenSequence
+from .spec import make_proposer
 
 
 class GenerationEngine:
@@ -52,6 +54,19 @@ class GenerationEngine:
         prefill over their longest cached prefix (None reads
         ``HVD_TPU_GEN_PREFIX_CACHE``, default on; cached-prefix decode
         is bit-identical to cold decode either way).
+      spec_mode: speculative decoding proposer — ``off`` | ``ngram``
+        (prompt-lookup self-drafting) | ``draft`` (requires
+        ``draft_model``). None reads ``HVD_TPU_GEN_SPEC_MODE``. Spec
+        output is bit-identical to plain decode (greedy AND seeded
+        sampling, logprobs included) — the knob only buys throughput.
+      spec_tokens: static draft width of the compiled verify program
+        (None reads ``HVD_TPU_GEN_SPEC_TOKENS``).
+      max_beams: widest ``num_beams`` this engine accepts; the beam
+        step program is compiled for this top-K. 1 disables beam
+        search entirely (None reads ``HVD_TPU_GEN_BEAMS``).
+      draft_model / draft_params / draft_checkpoint_dir: the small
+        draft transformer for ``spec_mode='draft'`` and its params
+        plumbing (restored through its own :class:`ParamsLifecycle`).
       on_step: optional scheduler observability hook
         (``on_step(phase, [seq_id, ...])``).
 
@@ -74,12 +89,23 @@ class GenerationEngine:
                  async_depth: Optional[int] = None,
                  prefix_cache: Optional[bool] = None,
                  reload_poll_seconds: Optional[float] = None,
+                 spec_mode: Optional[str] = None,
+                 spec_tokens: Optional[int] = None,
+                 max_beams: Optional[int] = None,
+                 draft_model=None, draft_params: Any = None,
+                 draft_checkpoint_dir: Optional[str] = None,
                  on_step=None, role: Optional[str] = None):
         cfg = _config.live_config()
         block_size = int(cfg.get(_config.GEN_BLOCK_SIZE)
                          if block_size is None else block_size)
         num_blocks = int(cfg.get(_config.GEN_NUM_BLOCKS)
                          if num_blocks is None else num_blocks)
+        spec_mode = str(cfg.get(_config.GEN_SPEC_MODE)
+                        if spec_mode is None else spec_mode).strip().lower()
+        spec_tokens = int(cfg.get(_config.GEN_SPEC_TOKENS)
+                          if spec_tokens is None else spec_tokens)
+        max_beams = int(cfg.get(_config.GEN_BEAMS)
+                        if max_beams is None else max_beams)
         self.model = model
         self._lifecycle = ParamsLifecycle(
             checkpoint_dir=checkpoint_dir, params=params, sharding=sharding,
@@ -88,6 +114,14 @@ class GenerationEngine:
         self.allocator = BlockAllocator(num_blocks, block_size,
                                         prefix_cache=prefix_cache)
         pools = make_pools(model.cfg, num_blocks, block_size)
+        self._proposer = make_proposer(
+            spec_mode, draft_model=draft_model, params=draft_params,
+            checkpoint_dir=draft_checkpoint_dir) \
+            if spec_mode not in ("", "off", "0", "false", "none") else None
+        verify_prog = (build_verify_program(model, spec_tokens)
+                       if self._proposer is not None else None)
+        beam_prog = (build_beam_program(model, max_beams, DECODE_WIDTH)
+                     if max_beams > 1 else None)
         self.batcher = ContinuousBatcher(
             (build_prefill_program(model),
              build_decode_program(model, DECODE_WIDTH)),
@@ -97,6 +131,9 @@ class GenerationEngine:
             prefill_chunk=prefill_chunk, queue_depth=queue_depth,
             deadline_ms=deadline_ms, eos_id=eos_id,
             vocab_size=model.cfg.vocab_size, async_depth=async_depth,
+            verify_program=verify_prog, proposer=self._proposer,
+            spec_mode=spec_mode, spec_tokens=spec_tokens,
+            beam_program=beam_prog, max_beams=max_beams,
             on_step=on_step, role=role)
         self._lifecycle.start_poller()    # last: nothing can fail past here
 
@@ -111,7 +148,8 @@ class GenerationEngine:
                seed: Optional[int] = None,
                request_id: Optional[str] = None,
                budget_ms: Optional[float] = None,
-               sample_offset: int = 0) -> GenSequence:
+               sample_offset: int = 0,
+               num_beams: Optional[int] = None) -> GenSequence:
         """Admit one request; returns the sequence handle for
         :meth:`result` / :meth:`stream`. Raises ``QueueFullError``
         (503) / ``DeadlineExceededError`` (429) / ``ValueError``
@@ -125,14 +163,17 @@ class GenerationEngine:
         end-to-end latency budget (never resets, unlike
         ``deadline_ms``); ``sample_offset`` offsets the PRNG emission
         ordinal so a failover resume of ``prompt + emitted`` continues
-        the original sampled stream bit-identically."""
+        the original sampled stream bit-identically. ``num_beams`` > 1
+        runs greedy beam search (requires an engine constructed with
+        ``max_beams`` > 1); width 1 is plain decode."""
         return self.batcher.submit(prompt, max_tokens=max_tokens,
                                    eos_id=eos_id, deadline_ms=deadline_ms,
                                    temperature=temperature, top_k=top_k,
                                    top_p=top_p, seed=seed,
                                    request_id=request_id,
                                    budget_ms=budget_ms,
-                                   sample_offset=sample_offset)
+                                   sample_offset=sample_offset,
+                                   num_beams=num_beams)
 
     def result(self, seq: GenSequence,
                timeout: Optional[float] = None) -> List[int]:
@@ -199,6 +240,23 @@ class GenerationEngine:
         (``HVD_TPU_DISAGG_ROLE``): prefill | decode | colocated."""
         return self.batcher.role
 
+    @property
+    def spec_mode(self) -> str:
+        """The active speculative-decoding proposer: off|ngram|draft."""
+        return self.batcher.spec_mode if self.batcher.spec else "off"
+
+    @property
+    def spec_tokens(self) -> int:
+        """Static draft width of the verify program (meaningful when
+        :attr:`spec_mode` != ``off``)."""
+        return self.batcher.spec_tokens
+
+    @property
+    def max_beams(self) -> int:
+        """Widest ``num_beams`` this engine accepts (1 = beam search
+        disabled)."""
+        return self.batcher.max_beams
+
     # -- disaggregated KV transfer surface -----------------------------------
 
     def kv_manifest(self, prompt: Sequence[int]) -> List[str]:
@@ -240,6 +298,8 @@ class GenerationEngine:
         """Idempotent: stop the reload poller and the scheduler thread
         (queued/running sequences fail; all KV blocks return)."""
         self._lifecycle.close(timeout=timeout)
+        if self._proposer is not None and hasattr(self._proposer, "close"):
+            self._proposer.close(timeout=timeout)
         self.batcher.stop(timeout=timeout)
 
     def __enter__(self):

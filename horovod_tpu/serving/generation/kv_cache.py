@@ -387,6 +387,31 @@ class BlockAllocator:
             self._publish(in_use, stats)
         return out
 
+    def share(self, blocks: Sequence[int]) -> None:
+        """Bump the refcount of already-live blocks — the beam-search
+        fork path: a child beam attaches its parent's full prefix
+        blocks instead of copying them, exactly like a prefix-cache
+        :meth:`match` except the blocks are named directly (beams of
+        one request share blocks whether or not the content index is
+        enabled). Sharing a free, cached, or null block raises — only a
+        live owner can be forked from."""
+        bl = list(blocks)
+        with self._lock:
+            for b in bl:
+                if b not in self._ref:
+                    raise ValueError(
+                        f"share of KV block {b} with no live owner")
+            for b in bl:
+                r = self._ref[b] + 1
+                self._ref[b] = r
+                if r == 2:
+                    self._n_shared += 1
+            in_use = len(self._ref)
+            if in_use > self.peak_in_use:
+                self.peak_in_use = in_use
+            stats = self._stats_locked()
+        self._publish(in_use, stats)
+
     def reset_cache(self) -> None:
         """Drop the whole content index and recycle every cached-free
         block. Called when cache *contents* stop being trustworthy —
@@ -662,3 +687,167 @@ def build_decode_program(model, decode_width: int = 2):
         return cache.k, cache.v, new_state, token, logprob
 
     return jax.jit(_decode, donate_argnums=(1, 2, 4))
+
+
+@functools.lru_cache(maxsize=8)
+def build_verify_program(model, spec_tokens: int):
+    """The speculative-decoding verify step:
+    ``(params, k, v, tables, DecodeState, draft (B, S), draft_len (B,))
+    -> (k, v, DecodeState, pred (B, S+1), logprob (B, S+1),
+    n_emit (B,))``.
+
+    One paged forward scores a lane's current input token plus up to
+    ``S = spec_tokens`` drafted continuations in a single chunk of
+    static width ``S+1`` — the memory-bound decode step's weight read
+    amortized over every position. Per position ``i`` the program
+    recomputes exactly the token the plain decoder would have produced
+    there (:func:`sample_tokens` under the deterministic
+    ``fold_in(key, emitted + i)`` draw — greedy AND seeded sampling),
+    accepts the longest drafted prefix matching those tokens, and emits
+    one bonus token past it (the correction at the first mismatch, or
+    the free extra token when every draft held). Output is therefore
+    BIT-IDENTICAL to non-speculative decode, logprobs included; the
+    draft only decides how many steps it took.
+
+    Cache discipline: the forward writes K/V for every chunk position,
+    because position ``i``'s logits must attend to drafts ``< i``.
+    Rejected positions are then *rolled back* — their slots' original
+    contents (snapshotted before the forward) are scattered back, with
+    the restore writes of *committed* positions routed to the null
+    block — so the pools end the step exactly as if only the accepted
+    tokens had ever been written. Dead lanes' writes route to the null
+    block throughout, as in the decode program. A lane with
+    ``draft_len == 0`` degrades to precisely the plain decode step
+    (accept 0 drafts, emit 1 token).
+
+    ``k``/``v`` and the state are donated; ``tables`` is not. The
+    per-step transfer is ``(B, S+1)`` tokens + logprobs plus the
+    ``(B,)`` accept count — still never logits.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    S = int(spec_tokens)
+    if S < 1:
+        raise ValueError(f"spec_tokens={spec_tokens}: must be >= 1")
+    C = S + 1
+
+    def _verify(params, k, v, tables, state, draft, draft_len):
+        B = state.tokens.shape[0]
+        block_size = k.shape[2]
+        live = jnp.minimum(state.live, 1).astype(jnp.int32)
+        alive = live > 0
+        # a draft may never reach past the lane's budget: emitting n
+        # tokens writes n-1 draft positions, so draft_len is capped at
+        # remaining-1 and the chunk never writes beyond the sequence's
+        # admitted total (whose blocks the scheduler guarantees)
+        dl = jnp.clip(draft_len, 0, jnp.maximum(state.remaining - 1, 0))
+        chunk = jnp.concatenate([state.tokens[:, None], draft], axis=1)
+        width = jnp.where(alive, 1 + dl, 0).astype(jnp.int32)
+
+        # snapshot the chunk's slots BEFORE the forward so rejected
+        # writes can be rolled back afterwards. Positions past a lane's
+        # table clamp inside the gather; their restore writes put back
+        # the very values just read — a no-op, not corruption.
+        positions = state.lengths[:, None] + jnp.arange(C)[None, :]
+        blocks = jnp.take_along_axis(
+            tables, jnp.minimum(positions // block_size,
+                                tables.shape[1] - 1), axis=1)
+        offsets = positions % block_size
+        orig_k = k[:, blocks, offsets]
+        orig_v = v[:, blocks, offsets]
+
+        cache = PagedCache(k, v, tables, state.lengths, width)
+        logits, cache = model.apply(params, chunk, cache=cache)
+
+        # per-position resample: position i's draw is the plain
+        # decoder's emission `emitted + i` — same ops, same fold_in,
+        # same logprob, so acceptance == equality with plain decode
+        preds, logps = [], []
+        for i in range(C):
+            t_i, lp_i = sample_tokens(
+                logits[:, i],
+                dataclasses.replace(state.sample,
+                                    emitted=state.sample.emitted + i))
+            preds.append(t_i)
+            logps.append(lp_i)
+        pred = jnp.stack(preds, axis=1)
+        logp = jnp.stack(logps, axis=1)
+
+        # longest accepted prefix: draft[i] must equal what the plain
+        # decoder produced at position i, for every earlier i too
+        ar = jnp.arange(S)[None, :]
+        match = (pred[:, :S] == draft) & (ar < dl[:, None])
+        accept = jnp.sum(jnp.cumprod(match.astype(jnp.int32), axis=1),
+                         axis=1)
+        # the plain decoder stops at its first EOS: clip the emission
+        # to one past the first predicted EOS, and to the budget
+        is_eos = (state.eos[:, None] >= 0) & (pred == state.eos[:, None])
+        no_eos = jnp.cumprod(1 - is_eos.astype(jnp.int32), axis=1)
+        lead = jnp.sum(no_eos, axis=1)          # positions before 1st EOS
+        eos_limit = jnp.where(lead < C, lead + 1, C + 1)
+        n_emit = jnp.minimum(accept + 1,
+                             jnp.minimum(eos_limit, state.remaining))
+        n_emit = jnp.where(alive, n_emit, 0).astype(jnp.int32)
+
+        # roll back rejected slots: restore originals everywhere except
+        # the committed prefix, whose restore writes go to block 0
+        committed = jnp.arange(C)[None, :] < n_emit[:, None]
+        rb = jnp.where(committed, 0, blocks)
+        new_k = cache.k.at[:, rb, offsets].set(orig_k)
+        new_v = cache.v.at[:, rb, offsets].set(orig_v)
+
+        retired = alive & ((lead < n_emit) | (state.remaining <= n_emit))
+        last = jnp.take_along_axis(
+            pred, jnp.maximum(n_emit - 1, 0)[:, None], axis=1)[:, 0]
+        token = jnp.where(alive & (n_emit > 0), last, state.tokens)
+        new_state = DecodeState(
+            tokens=token,
+            lengths=state.lengths + n_emit,
+            live=jnp.where(retired, 0, live),
+            remaining=state.remaining - n_emit,
+            eos=state.eos,
+            sample=dataclasses.replace(
+                state.sample, emitted=state.sample.emitted + n_emit))
+        return new_k, new_v, new_state, pred, logp, n_emit
+
+    return jax.jit(_verify, donate_argnums=(1, 2, 4))
+
+
+@functools.lru_cache(maxsize=8)
+def build_beam_program(model, beam_k: int, decode_width: int = 2):
+    """The beam-search step:
+    ``(params, k, v, tables, tokens (B,), lengths (B,), live (B,)) ->
+    (k, v, top_tok (B, beam_k), top_lp (B, beam_k))``.
+
+    The decode program's forward — identical chunk shape, identical
+    K/V write path — returning the ``beam_k`` highest-logprob
+    continuations per lane instead of one sampled token, so the host
+    can run hypothesis selection. ``top_lp`` is the full-distribution
+    ``log_softmax`` value (the same quantity :func:`sample_tokens`
+    reports), and ``lax.top_k`` breaks ties toward the lowest index
+    exactly like ``argmax`` — which is why a width-1 beam is
+    bit-identical to plain greedy decode, logprobs included. Beam
+    state (tokens/lengths/live/tables) is host-managed: the beam loop
+    is synchronous and re-forms the batch every step as beams fork and
+    finish. ``k``/``v`` are donated."""
+    import jax
+    import jax.numpy as jnp
+
+    K = int(beam_k)
+    if K < 1:
+        raise ValueError(f"beam_k={beam_k}: must be >= 1")
+
+    def _beam_step(params, k, v, tables, tokens, lengths, live):
+        B = tokens.shape[0]
+        chunk = jnp.zeros((B, decode_width), jnp.int32)
+        chunk = chunk.at[:, 0].set(tokens)
+        live = jnp.minimum(live, 1).astype(jnp.int32)
+        cache = PagedCache(k, v, tables, lengths, live)
+        logits, cache = model.apply(params, chunk, cache=cache,
+                                    logits_at=jnp.zeros((B,), jnp.int32))
+        top_lp, top_tok = jax.lax.top_k(
+            jax.nn.log_softmax(logits, axis=-1), K)
+        return cache.k, cache.v, top_tok.astype(jnp.int32), top_lp
+
+    return jax.jit(_beam_step, donate_argnums=(1, 2))

@@ -85,13 +85,39 @@ the scheduler thread inside the same admit/retire/preempt paths whose
 membership changes already drain the in-flight pipeline first, so
 speculation never observes a half-updated block table.
 
+**Speculative decoding** (``HVD_TPU_GEN_SPEC_MODE``) replaces the
+one-token decode step with a draft-and-verify step: a host-side
+proposer (:mod:`.spec`) guesses up to ``HVD_TPU_GEN_SPEC_TOKENS``
+continuation tokens per lane, and the compiled verify program scores
+all of them in ONE paged forward, accepting the longest prefix equal
+to what the plain decoder would have produced (the deterministic
+``fold_in(key, emitted)`` draw is recomputed at every position, so
+speculative output is bit-identical to plain decode for greedy AND
+seeded sampling, logprobs included). The spec loop runs synchronously
+— drafting needs the host-visible emitted history, so there is no
+step to overlap — and multi-token emission is what pays: each
+accepted draft saves a whole decode-step weight read. Rejected draft
+positions are rolled back through the null block inside the program;
+the cache is never corrupted by an unaccepted token.
+
+**Beam search** (``num_beams > 1`` at submit; greedy only) runs as a
+synchronous sub-loop the moment the request enters decode: width-W
+hypothesis sets advance together through the compiled beam step
+(top-k logprobs per lane), children of a fork share their parent's
+full prefix blocks through the refcounted allocator
+(:meth:`~.kv_cache.BlockAllocator.share`) and copy only the partial
+tail block at divergence. ``num_beams=1`` is bit-identical to plain
+greedy decode.
+
 Fault sites: ``serving.prefill`` (each prefill chunk — an ``error``
 fails only that sequence), ``serving.decode`` (each decode-step
 enqueue — an ``error`` fails only the sequences in that step's batch;
 an in-flight speculative step is drained first, so already-produced
-tokens are delivered and waiting sequences serve next), and
-``serving.evict`` (each preemption — an ``error`` fails the evicted
-sequence instead of requeueing it). See docs/robustness.md.
+tokens are delivered and waiting sequences serve next),
+``serving.verify`` (each speculative verify step — an ``error`` fails
+that step's batch, the spec-plane analogue of ``serving.decode``),
+and ``serving.evict`` (each preemption — an ``error`` fails the
+evicted sequence instead of requeueing it). See docs/robustness.md.
 """
 
 import collections
@@ -167,10 +193,36 @@ _M_STEP = _metrics.histogram(
     "bookkeeping, enqueue). With HVD_TPU_GEN_ASYNC_DEPTH=1 the host "
     "share overlaps the in-flight device step; a host share rivaling "
     "the device share at depth 0 is the signal that async stepping "
-    "pays.",
+    "pays. With speculative decoding on, 'verify' is the wait on the "
+    "draft-verify program specifically (a subset of the device "
+    "share): compare its per-observation cost against the plain "
+    "decode step times the accept length to see what speculation "
+    "buys.",
     labels=("component",),
     buckets=(0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
              0.25, 1.0))
+_M_SPEC_DRAFTED = _metrics.counter(
+    "hvd_tpu_gen_spec_drafted_total",
+    "Tokens proposed by the speculative-decoding drafter "
+    "(HVD_TPU_GEN_SPEC_MODE), summed over lanes and verify steps. "
+    "accepted/drafted is the fleet accept rate — the single number "
+    "that says whether speculation pays on this workload.")
+_M_SPEC_ACCEPTED = _metrics.counter(
+    "hvd_tpu_gen_spec_accepted_total",
+    "Drafted tokens the verify step accepted (they equalled what the "
+    "plain decoder would have produced at their position). Every "
+    "accepted token is a decode-step weight read saved; the bonus "
+    "token each verify step emits past the accepted prefix is not "
+    "counted here — it is not a draft.")
+_M_SPEC_ACCEPT_LEN = _metrics.histogram(
+    "hvd_tpu_gen_spec_accept_length",
+    "Accepted drafted tokens per lane per verify step (0 = the draft "
+    "missed immediately and the step degraded to plain decode's one "
+    "token). Mass pinned at HVD_TPU_GEN_SPEC_TOKENS means the draft "
+    "width, not the proposer, is the binding constraint — raising it "
+    "may pay; mass at 0 means speculation is pure overhead on this "
+    "workload.",
+    buckets=(0, 1, 2, 3, 4, 6, 8, 16))
 
 class RequestCancelledError(RuntimeError):
     """The request was cancelled via :meth:`ContinuousBatcher.cancel`
@@ -181,6 +233,10 @@ class RequestCancelledError(RuntimeError):
 
 _FP_PREFILL = _faults.FaultPoint("serving.prefill")
 _FP_DECODE = _faults.FaultPoint("serving.decode")
+# the speculative verify step's own site: an ``error`` fails exactly
+# the sequences in that verify batch (the spec-plane analogue of
+# serving.decode), waiting sequences serve next iteration
+_FP_VERIFY = _faults.FaultPoint("serving.verify")
 _FP_EVICT = _faults.FaultPoint("serving.evict")
 # SDC drill for the generation plane: a ``nan`` rule poisons ONE live
 # lane's logprob after the device step — the blast-radius contract
@@ -268,14 +324,16 @@ class GenSequence:
                  "resume_decode", "state", "error", "stream_q",
                  "done_event", "arrived_at", "temperature", "top_k",
                  "top_p", "seed", "key", "sample_offset", "prefix_hashes",
-                 "block_hashes", "cache_gen", "request_id", "trace")
+                 "block_hashes", "cache_gen", "request_id", "trace",
+                 "num_beams")
 
     def __init__(self, seq_id: int, prompt: List[int], max_tokens: int,
                  eos_id: Optional[int], deadline_s: float,
                  temperature: float = 0.0, top_k: int = 0,
                  top_p: float = 1.0, seed: Optional[int] = None,
                  request_id: Optional[str] = None,
-                 budget_s: float = 0.0, sample_offset: int = 0):
+                 budget_s: float = 0.0, sample_offset: int = 0,
+                 num_beams: int = 1):
         self.id = seq_id
         self.prompt = list(prompt)
         self.max_tokens = int(max_tokens)
@@ -296,6 +354,11 @@ class GenSequence:
         #: emitted-ordinal) chain exactly where the dead replica
         #: stopped, making the resumed continuation bit-identical
         self.sample_offset = int(sample_offset)
+        #: beam width (1 = plain decode). Beam requests prefill
+        #: prompt[:-1] only — the beam loop's first step feeds the last
+        #: prompt token through the beam program, so the FIRST generated
+        #: token branches into the top-W hypotheses too
+        self.num_beams = int(num_beams)
         self.temperature = float(temperature)
         self.top_k = int(top_k)
         self.top_p = float(top_p)
@@ -386,7 +449,13 @@ class ContinuousBatcher:
                  vocab_size: Optional[int] = None,
                  async_depth: Optional[int] = None,
                  on_step: Optional[Callable] = None,
-                 role: Optional[str] = None):
+                 role: Optional[str] = None,
+                 verify_program: Optional[Callable] = None,
+                 proposer=None,
+                 spec_mode: Optional[str] = None,
+                 spec_tokens: Optional[int] = None,
+                 beam_program: Optional[Callable] = None,
+                 max_beams: Optional[int] = None):
         cfg = _config.live_config()
         #: disaggregated operating mode (HVD_TPU_DISAGG_ROLE):
         #: 'colocated' runs prefill + decode as always; 'prefill'
@@ -430,6 +499,24 @@ class ContinuousBatcher:
         self.eos_id = eos_id
         self.vocab_size = vocab_size
         self.on_step = on_step
+        #: speculative decoding: both halves (the compiled verify step
+        #: and a host-side proposer) must be present for the spec loop
+        #: to replace the plain decode loop
+        self._verify_prog = verify_program
+        self._proposer = proposer
+        self.spec_tokens = int(cfg.get(_config.GEN_SPEC_TOKENS)
+                               if spec_tokens is None else spec_tokens)
+        self.spec_mode = str(
+            ("off" if proposer is None else "ngram")
+            if spec_mode is None else spec_mode).strip().lower()
+        self.spec = (self._verify_prog is not None
+                     and self._proposer is not None)
+        #: beam search: the compiled top-k beam step; requests with
+        #: num_beams > 1 are rejected at submit when absent
+        self._beam_prog = beam_program
+        self.max_beams = (int(cfg.get(_config.GEN_BEAMS)
+                              if max_beams is None else max_beams)
+                          if beam_program is not None else 1)
         #: table width: every sequence's block table is padded to the
         #: worst-case block count, so the compiled shapes never move
         self.max_blocks = allocator.blocks_for(self.max_seq_len)
@@ -474,7 +561,8 @@ class ContinuousBatcher:
                seed: Optional[int] = None,
                request_id: Optional[str] = None,
                budget_ms: Optional[float] = None,
-               sample_offset: int = 0) -> GenSequence:
+               sample_offset: int = 0,
+               num_beams: Optional[int] = None) -> GenSequence:
         """Admit one generation request. Raises
         :class:`~horovod_tpu.serving.batcher.QueueFullError` on a full
         queue (HTTP 503), ``ValueError`` for a request that could never
@@ -499,6 +587,12 @@ class ContinuousBatcher:
         emitted tokens, so a failover resume of ``prompt + emitted``
         with the original seed replays the uninterrupted continuation
         bit-identically.
+
+        ``num_beams`` > 1 runs beam search (greedy scoring only —
+        sampled beams are rejected): W hypotheses advance together,
+        sharing prefix KV blocks, and the single highest-cumulative-
+        logprob finished hypothesis is delivered. ``num_beams=1`` (the
+        default) is bit-identical to plain greedy decode.
         """
         prompt = [int(t) for t in prompt]
         if not prompt:
@@ -524,6 +618,26 @@ class ContinuousBatcher:
         if not 0.0 < top_p <= 1.0:
             raise ValueError(
                 f"top_p={top_p}: must be in (0, 1] (1 disables)")
+        num_beams = 1 if num_beams is None else int(num_beams)
+        if num_beams < 1:
+            raise ValueError(f"num_beams={num_beams}: must be >= 1")
+        if num_beams > 1:
+            if self._beam_prog is None:
+                raise ValueError(
+                    "beam search is disabled on this engine (no beam "
+                    "program compiled; construct the GenerationEngine "
+                    "with max_beams > 1 / HVD_TPU_GEN_BEAMS)")
+            cap = min(self.max_beams, self.max_seqs)
+            if num_beams > cap:
+                raise ValueError(
+                    f"num_beams={num_beams} exceeds this engine's beam "
+                    f"cap {cap} (min of HVD_TPU_GEN_BEAMS and "
+                    f"HVD_TPU_GEN_MAX_SEQS)")
+            if temperature > 0.0:
+                raise ValueError(
+                    "num_beams > 1 requires greedy decoding "
+                    "(temperature 0): beam search maximizes cumulative "
+                    "logprob, which sampling contradicts")
         total = len(prompt) + int(max_tokens)
         if total > self.max_seq_len:
             raise ValueError(
@@ -563,8 +677,15 @@ class ContinuousBatcher:
                           self.eos_id if eos_id is None else eos_id,
                           ddl_s, temperature=temperature, top_k=top_k,
                           top_p=top_p, seed=seed, request_id=request_id,
-                          budget_s=budget_s, sample_offset=sample_offset)
+                          budget_s=budget_s, sample_offset=sample_offset,
+                          num_beams=num_beams)
         _tracing.note_request(request_id)
+        if num_beams > 1:
+            # beam requests hold back the prompt's last token from
+            # prefill so the FIRST generated position also branches
+            # into the top-W continuations (prefilling it would commit
+            # a single greedy path one step early)
+            seq.prefill_tokens = seq.prompt[:-1]
         if self._prefix_cache:
             # hashed on the submitter's thread (pure computation on a
             # sequence the scheduler can't see yet) so the hot loop
@@ -1043,7 +1164,15 @@ class ContinuousBatcher:
         if s.prefilled == total:
             s.state = "decode"
             self._epoch += 1        # a new lane joins the decode batch
-            if s.resume_decode:
+            if s.num_beams > 1:
+                # beam requests held the prompt's last token back from
+                # prefill: it is the beam loop's first input, so the
+                # first generated position branches into the top-W
+                # hypotheses too. The chunk's sampled token is
+                # discarded — the beam program re-scores the same
+                # position from the identical cache state.
+                s.next_input = s.prompt[-1]
+            elif s.resume_decode:
                 # recompute path: the cache now holds prompt + all but
                 # the newest generated token; the next decode input is
                 # that newest token, already emitted before preemption
@@ -1092,8 +1221,17 @@ class ContinuousBatcher:
     # -- decode --------------------------------------------------------------
 
     def _decode_step(self, now: float) -> None:
+        for s in [x for x in self._running
+                  if x.state == "decode" and x.num_beams > 1]:
+            # beam requests run their whole search synchronously —
+            # they never join the lane-batched decode state below
+            self._run_beam(s, now)
+        if self.spec:
+            self._spec_decode_step(now)
+            return
         if not self._inflight \
-                and not any(x.state == "decode" for x in self._running):
+                and not any(x.state == "decode" and x.num_beams == 1
+                            for x in self._running):
             return
         # membership drifted (admit/host-retire/preempt) since the device
         # state was built: drain the pipeline before touching it
@@ -1141,13 +1279,19 @@ class ContinuousBatcher:
         in flight plus the one about to be enqueued. Returns the (one)
         sorted decode list on success, or None after a flush/preemption
         changed the projections and the caller must recompute."""
-        batch = sorted((x for x in self._running if x.state == "decode"),
+        batch = sorted((x for x in self._running
+                        if x.state == "decode" and x.num_beams == 1),
                        key=lambda x: x.id)
         for s in batch:
             if s.state != "decode":
                 continue    # preempted while growing an older peer
             pending = len(self._inflight) if s in self._lanes else 0
-            need = self._alloc.blocks_for(s.cache_len + pending + 1) \
+            # a speculative step may commit up to 1 + spec_tokens
+            # positions at once; reserving the full chunk up front is
+            # at worst a few blocks of slack, never a correctness risk
+            width = 1 if not self.spec else 1 + max(0, min(
+                self.spec_tokens, s.max_tokens - len(s.generated) - 1))
+            need = self._alloc.blocks_for(s.cache_len + pending + width) \
                 - len(s.blocks)
             if need <= 0:
                 continue
@@ -1255,6 +1399,311 @@ class ContinuousBatcher:
             _M_OCCUPANCY.observe(len(emitted))
             if self.on_step is not None:
                 self.on_step("decode", emitted)
+
+    # -- speculative decode --------------------------------------------------
+
+    def _spec_decode_step(self, now: float) -> None:
+        """One speculative step: draft on the host, verify the whole
+        chunk in one paged forward, emit the accepted prefix plus the
+        verifier's own next token. Output is bit-identical to the plain
+        loop — the verify program recomputes the deterministic sample
+        at every position — so drafting only ever changes throughput.
+        The loop is synchronous (no async pipeline): the proposer needs
+        host-visible history, so every step round-trips anyway."""
+        self._flush_inflight()  # leftover plain-path flights, if any
+        if not any(x.state == "decode" and x.num_beams == 1
+                   for x in self._running):
+            return
+        while True:
+            batch = self._ensure_decode_blocks()
+            if batch is not None:
+                break
+        batch = [x for x in batch if x.state == "decode"]
+        if not batch:
+            return
+        if self._dstate is None or self._state_epoch != self._epoch:
+            self._build_dstate(batch)
+        S = self.spec_tokens
+        B = self.max_seqs
+        draft = np.zeros((B, S), np.int32)
+        dlen = np.zeros((B,), np.int32)
+        drafted = 0
+        for i, s in enumerate(self._lanes):
+            if s is None or s.state != "decode":
+                continue
+            # never draft into the final position: the verifier's own
+            # sample always takes the last slot, so a full-length
+            # accept still retires exactly where plain decode would
+            cap = min(S, s.max_tokens - len(s.generated) - 1)
+            if cap <= 0:
+                continue
+            d = self._proposer.propose(s.prompt + s.generated, cap)[:cap]
+            draft[i, :len(d)] = d
+            dlen[i] = len(d)
+            drafted += len(d)
+        if drafted:
+            _M_SPEC_DRAFTED.inc(drafted)
+        try:
+            _FP_VERIFY.fire()
+        except Exception as e:  # noqa: BLE001 — fails only this batch
+            for s in batch:
+                if s.state == "decode":
+                    self._deliver_error(s, e)
+            return
+        if self._tables_dirty:
+            self._upload_tables()
+        try:
+            out = self._verify_prog(self._params(), self._k, self._v,
+                                    self._dtables, self._dstate,
+                                    jnp.asarray(draft), jnp.asarray(dlen))
+        except Exception:  # noqa: BLE001
+            self._reset_device()
+            return
+        self._k, self._v, self._dstate, pred_d, logp_d, n_emit_d = out
+        t0 = time.perf_counter()
+        try:
+            pred = np.asarray(pred_d)
+            logp = np.asarray(logp_d)
+            n_emit = np.asarray(n_emit_d)
+        except Exception:  # noqa: BLE001 — the device step itself died
+            self._reset_device()
+            return
+        dt = time.perf_counter() - t0
+        self._blocked_s += dt
+        # the verify transfer wait is the spec loop's device-blocked
+        # share of the step — published both as the aggregate device
+        # component (above) and under its own label for accept-rate
+        # tuning
+        _M_STEP.labels(component="verify").observe(dt)
+        logp = _corrupt_logprobs(logp, self._lanes)  # serving.logprob
+        emitted = []
+        for i, s in enumerate(list(self._lanes)):
+            if s is None or s.state != "decode":
+                continue
+            n = int(n_emit[i])
+            _M_SPEC_ACCEPTED.inc(max(0, n - 1))
+            _M_SPEC_ACCEPT_LEN.observe(max(0, n - 1))
+            for j in range(n):
+                if not np.isfinite(logp[i, j]):
+                    # same blast radius as the plain loop: exactly this
+                    # sequence fails, batchmates keep their tokens
+                    self._deliver_error(s, RuntimeError(
+                        f"non-finite logprob for sequence {s.id}: "
+                        f"silent data corruption in the verify step"))
+                    break
+                s.cache_len += 1
+                if s.cache_len % self._alloc.block_size == 0:
+                    self._register_full_blocks(s)
+                _M_TOKENS.labels(phase="decode").inc()
+                self._emit(s, int(pred[i, j]), float(logp[i, j]), now)
+                if s.state != "decode":
+                    break       # retired on EOS/max_tokens mid-chunk
+            if n:
+                emitted.append(s.id)
+        if emitted:
+            _M_OCCUPANCY.observe(len(emitted))
+            if self.on_step is not None:
+                self.on_step("decode", emitted)
+
+    # -- beam search ---------------------------------------------------------
+
+    def _run_beam(self, s: GenSequence, now: float) -> None:
+        """Run ``s``'s whole width-W beam search synchronously and
+        deliver the highest-logprob finished hypothesis. Hypotheses are
+        host-side dicts; their K/V lives in per-hypothesis block lists
+        that fork copy-on-extend — full blocks are refcount-shared
+        through the allocator, only the partial tail block is
+        device-copied at divergence. Beam lanes never touch the plain
+        loop's decode state (``_lanes``/``_dstate``)."""
+        self._flush_inflight()
+        if s.state != "decode":
+            return
+        W = s.num_beams
+        bs = self._alloc.block_size
+        root = {"tokens": [], "logprobs": [], "score": 0.0,
+                "next_input": s.next_input, "cache_len": s.cache_len,
+                "blocks": s.blocks}
+        s.blocks = []       # ownership moved to the root hypothesis
+        active = [root]
+        finished: List[dict] = []
+
+        def _free_hyps(hyps) -> None:
+            for h in hyps:
+                if h["blocks"]:
+                    self._alloc.free(h["blocks"])
+                    h["blocks"] = []
+
+        def _take(n: int):
+            """Allocate ``n`` blocks, preempting younger peers on
+            exhaustion exactly like :meth:`_grow`; None when even that
+            cannot cover it (the caller fails ``s``)."""
+            while True:
+                try:
+                    return self._alloc.allocate(n)
+                except BlocksExhaustedError:
+                    victims = [x for x in self._running
+                               if x.id > s.id and x.blocks]
+                    if not victims:
+                        return None
+                    self._preempt(max(victims, key=lambda x: x.id))
+
+        while active:
+            now = time.monotonic()
+            if now > s.deadline or now > s.budget:
+                _free_hyps(active)
+                which = ("end-to-end budget" if now > s.budget
+                         else "deadline")
+                self._deliver_error(s, DeadlineExceededError(
+                    f"{which} expired during beam search for sequence "
+                    f"{s.id}"
+                    + (f" (request {s.request_id})" if s.request_id
+                       else ""), stage="decode"))
+                return
+            for h in active:
+                need = self._alloc.blocks_for(h["cache_len"] + 1) \
+                    - len(h["blocks"])
+                if need > 0:
+                    got = _take(need)
+                    if got is None:
+                        _free_hyps(active)
+                        self._deliver_error(s, BlocksExhaustedError(
+                            f"beam search (width {W}) for sequence "
+                            f"{s.id} exhausted the KV block pool with "
+                            f"no younger sequence left to preempt"))
+                        return
+                    h["blocks"].extend(got)
+            B = self.max_seqs
+            tables = np.zeros((B, self.max_blocks), np.int32)
+            tokens = np.zeros((B,), np.int32)
+            lengths = np.zeros((B,), np.int32)
+            live = np.zeros((B,), np.int32)
+            for i, h in enumerate(active):
+                tables[i, :len(h["blocks"])] = h["blocks"]
+                tokens[i] = h["next_input"]
+                lengths[i] = h["cache_len"]
+                live[i] = 1
+            try:
+                _FP_DECODE.fire()
+            except Exception as e:  # noqa: BLE001 — fails only s
+                _free_hyps(active)
+                self._deliver_error(s, e)
+                return
+            try:
+                out = self._beam_prog(
+                    self._params(), self._k, self._v,
+                    jnp.asarray(tables), jnp.asarray(tokens),
+                    jnp.asarray(lengths), jnp.asarray(live))
+            except Exception:  # noqa: BLE001
+                # beam blocks are invisible to _reset_device (s.blocks
+                # is empty): free them first or they leak forever
+                _free_hyps(active)
+                self._reset_device()
+                return
+            self._k, self._v, top_tok_d, top_lp_d = out
+            t0 = time.perf_counter()
+            try:
+                top_tok = np.asarray(top_tok_d)
+                top_lp = np.asarray(top_lp_d)
+            except Exception:  # noqa: BLE001
+                _free_hyps(active)
+                self._reset_device()
+                return
+            self._blocked_s += time.perf_counter() - t0
+            # candidate selection, best cumulative logprob first. Ties
+            # break toward the older hypothesis and the lower-ranked
+            # candidate — for W=1 that is exactly argmax, which is what
+            # makes width-1 bit-identical to greedy decode.
+            cands = []
+            for i in range(len(active)):
+                for j in range(top_tok.shape[1]):
+                    cands.append(
+                        (active[i]["score"] + float(top_lp[i, j]), i, j))
+            cands.sort(key=lambda c: (-c[0], c[1], c[2]))
+            sel = []        # (parent_idx, token, logprob, score)
+            for score, i, j in cands:
+                if len(sel) >= W:
+                    break
+                t = int(top_tok[i, j])
+                lp = float(top_lp[i, j])
+                h = active[i]
+                done_now = ((s.eos_id is not None and t == s.eos_id)
+                            or len(h["tokens"]) + 1 >= s.max_tokens)
+                if done_now:
+                    if len(finished) < W:
+                        finished.append(
+                            {"tokens": h["tokens"] + [t],
+                             "logprobs": h["logprobs"] + [lp],
+                             "score": score, "blocks": []})
+                    continue
+                sel.append((i, t, lp, score))
+            # fork: the first child of each parent inherits its block
+            # list wholesale; siblings share() the full blocks and
+            # device-copy the partial tail at the divergence point
+            snapshots = [list(h["blocks"]) for h in active]
+            claimed = set()
+            new_active: List[dict] = []
+            failed = False
+            for i, t, lp, score in sel:
+                L = active[i]["cache_len"] + 1   # resident after write
+                if i not in claimed:
+                    claimed.add(i)
+                    blocks = active[i]["blocks"]
+                    active[i]["blocks"] = []
+                else:
+                    pblocks = snapshots[i]
+                    full = L // bs
+                    blocks = []
+                    if full:
+                        self._alloc.share(pblocks[:full])
+                        blocks.extend(pblocks[:full])
+                    if L % bs:
+                        got = _take(1)
+                        if got is None:
+                            self._alloc.free(blocks)
+                            failed = True
+                            break
+                        blocks.extend(got)
+                        src = pblocks[full]
+                        self._k = self._k.at[:, got[0]].set(
+                            self._k[:, src])
+                        self._v = self._v.at[:, got[0]].set(
+                            self._v[:, src])
+                new_active.append(
+                    {"tokens": active[i]["tokens"] + [t],
+                     "logprobs": active[i]["logprobs"] + [lp],
+                     "score": score, "next_input": t,
+                     "cache_len": L, "blocks": blocks})
+            if failed:
+                _free_hyps(new_active)
+                _free_hyps(active)
+                self._deliver_error(s, BlocksExhaustedError(
+                    f"beam search (width {W}) for sequence {s.id} "
+                    f"could not fork a hypothesis: KV block pool "
+                    f"exhausted with no younger sequence to preempt"))
+                return
+            _free_hyps([h for i, h in enumerate(active)
+                        if i not in claimed])
+            active = new_active
+            if self.on_step is not None:
+                self.on_step("decode", [s.id])
+            if finished:
+                best_fin = max(f["score"] for f in finished)
+                # scores only fall as beams extend (logprobs <= 0), so
+                # a finished hypothesis at least as good as every
+                # survivor can never be overtaken
+                if len(finished) >= W or not active or best_fin >= max(
+                        h["score"] for h in active):
+                    break
+        pool = finished if finished else active
+        win = max(pool, key=lambda h: h["score"])
+        _free_hyps(active)
+        _M_TOKENS.labels(phase="decode").inc(len(win["tokens"]))
+        for t, lp in zip(win["tokens"], win["logprobs"]):
+            if s.state != "decode":
+                break
+            self._emit(s, int(t), float(lp), now)
+        if s.state != "done":
+            self._retire(s, device_synced=True)
 
     # -- shared machinery ----------------------------------------------------
 

@@ -167,6 +167,11 @@ class _ServingHandler(_http.QuietHandler):
             # pool membership for the disagg fleet: the router's
             # /fleet/health aggregates this per pool
             doc["disagg_role"] = self.server.gen_engine.role
+            # decode-feature homogeneity: routers assert a decode pool
+            # agrees on these before prestaging spec/beam traffic
+            doc["spec_mode"] = self.server.gen_engine.spec_mode
+            doc["spec_tokens"] = self.server.gen_engine.spec_tokens
+            doc["max_beams"] = self.server.gen_engine.max_beams
         self._respond(200, doc)
 
     def do_POST(self):  # noqa: N802
@@ -288,6 +293,7 @@ class _ServingHandler(_http.QuietHandler):
             seed=opt("seed", int),
             budget_ms=budget_ms,
             sample_offset=int(doc.get("sample_offset", 0)),
+            num_beams=opt("num_beams", int),
             request_id=self._request_id())
 
     def _generate(self) -> None:

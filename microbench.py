@@ -34,7 +34,12 @@ Runs nine sections, each in killable CPU subprocesses, and writes
    prefix caching on a shared-64-token-system-prompt workload, cache
    on vs off over the same compiled programs (outputs asserted
    identical), reporting tokens/sec, prefilled tokens, and the cache
-   hit/miss/eviction counters.
+   hit/miss/eviction counters. Plus ``generation_spec``: n-gram
+   speculative decoding vs plain decode on the single-stream latency
+   rig, a repetitive (high-accept) vs random (low-accept) workload
+   pair with outputs asserted bit-identical across spec on/off — the
+   repetitive-workload speedup and accept rate are the acceptance
+   numbers.
 6. ``sdc``          — SDC defense-plane overhead (docs/robustness.md)
    on the ResNet-50 161-gradient scenario: a jit'd update plain vs with
    the step guard fused in, plus the cross-replica parameter
@@ -211,19 +216,24 @@ def worker_injit(n: int, quick: bool) -> int:
 
 def worker_generation(quick: bool) -> int:
     from horovod_tpu.microbench import (generation_sweep, prefix_sweep,
-                                        sampling_sweep)
+                                        sampling_sweep, spec_sweep)
     row = generation_sweep(num_requests=12 if quick else 24)
     print(MB_TAG + json.dumps(row))
     row = sampling_sweep(num_requests=8 if quick else 16)
     print(MB_TAG + json.dumps(row))
     row = prefix_sweep(num_requests=12 if quick else 24)
     print(MB_TAG + json.dumps(row))
+    # max_tokens stays at 96 even in quick mode: the accept rate (and
+    # with it the headline speedup) needs the cycle to dominate the
+    # warmup transient, and a single-stream run is sub-second anyway
+    row = spec_sweep(max_tokens=96, repeats=2 if quick else 3)
+    print(MB_TAG + json.dumps(row))
     return 0
 
 
 def _run_generation(quick: bool, timeout: int):
-    """Returns [generation_sweep, sampling_sweep, prefix_sweep] rows
-    (or None)."""
+    """Returns [generation_sweep, sampling_sweep, prefix_sweep,
+    spec_sweep] rows (or None)."""
     p = None
     cmd = [sys.executable, os.path.abspath(__file__), "--worker-generation"]
     if quick:
@@ -447,10 +457,11 @@ def main():
     result["injit"] = injit_rows
 
     _log("section 5/9: continuous vs static batch generation + sampling")
-    gen_rows = _run_generation(quick, timeout=1200)
+    gen_rows = _run_generation(quick, timeout=1800)
     gen = gen_rows[0] if gen_rows else None
     sampling = gen_rows[1] if gen_rows and len(gen_rows) > 1 else None
     prefix = gen_rows[2] if gen_rows and len(gen_rows) > 2 else None
+    spec = gen_rows[3] if gen_rows and len(gen_rows) > 3 else None
     if gen:
         _log(f"  continuous {gen['continuous']['tokens_per_s']} tok/s "
              f"(x{gen['continuous_speedup']} vs static full-batch), "
@@ -468,9 +479,17 @@ def main():
              f"on vs {prefix['cache_off']['tokens_per_s']} off "
              f"(x{prefix['cache_speedup']}), prefill reduced "
              f"{prefix['prefill_reduction']:.0%}")
+    if spec:
+        rep = spec["modes"]["repetitive_spec"]
+        _log(f"  speculative: {rep['tokens_per_s']} tok/s spec-on "
+             f"repetitive (x{spec['spec_speedup_repetitive']} vs plain, "
+             f"accept {rep['accept_rate']}), random workload "
+             f"x{spec['spec_speedup_random']}, "
+             f"bit_identical={spec['bit_identical']}")
     result["generation"] = gen
     result["generation_sampling"] = sampling
     result["generation_prefix"] = prefix
+    result["generation_spec"] = spec
 
     _log("section 6/9: SDC guard + fingerprint overhead")
     sdc = _run_sdc(quick, timeout=600)
@@ -563,6 +582,13 @@ def main():
         if prefix else None,
         "gen_prefix_prefill_reduction": prefix["prefill_reduction"]
         if prefix else None,
+        "gen_spec_speedup_repetitive": spec["spec_speedup_repetitive"]
+        if spec else None,
+        "gen_spec_accept_rate_repetitive": spec["modes"]
+        ["repetitive_spec"]["accept_rate"] if spec else None,
+        "gen_spec_speedup_random": spec["spec_speedup_random"]
+        if spec else None,
+        "gen_spec_bit_identical": spec["bit_identical"] if spec else None,
         "sdc_guard_overhead_pct": sdc["overhead_pct"] if sdc else None,
         "sdc_fingerprint_fold_ms": sdc["fingerprint_fold_ms"]
         if sdc else None,

@@ -248,6 +248,28 @@ def test_disagg_suite_is_seeded_and_exclusive():
     assert os.path.exists(os.path.join(root, "tests", "test_disagg.py"))
 
 
+def test_spec_suite_is_seeded_and_exclusive():
+    """The speculative-decoding + beam-search suite (n-gram drafting
+    with batched verification bit-identical to plain decode, the
+    failover-during-spec-decode drill, the seeded serving.verify chaos
+    drill, beam-vs-oracle parity, and the capability health surfaces)
+    runs seeded as its own CI suite; the generic unit and chaos suites
+    must not run the file twice, and the neighboring generation suites
+    stay scoped to their own files."""
+    by_name = {name: cmd for name, cmd, _t in COMMON_SUITES}
+    assert "serving-spec" in by_name
+    cmd = by_name["serving-spec"]
+    assert "HVD_TPU_FAULT_SEED=" in cmd
+    assert "tests/test_speculative.py" in cmd
+    assert "--ignore=tests/test_speculative.py" in by_name["unit"]
+    assert "--ignore=tests/test_speculative.py" in by_name["chaos"]
+    assert "tests/test_speculative.py" not in by_name["serving-gen"]
+    assert "tests/test_speculative.py" not in by_name["chaos-fleet-failover"]
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    assert os.path.exists(os.path.join(root, "tests",
+                                       "test_speculative.py"))
+
+
 def test_chaos_sdc_suite_is_seeded_and_exclusive():
     """The silent-data-corruption drills (step guard, fingerprints,
     skip/rollback/quarantine policy, 2-proc bitflip e2e drill) run as

@@ -214,16 +214,38 @@ class TestAllocatorPrefixCache:
         assert a.cached_blocks == 0 and a.free_blocks == 8
         assert a.match_probe(hs) == (0, 0)
 
+    def test_share_increfs_live_blocks_only(self):
+        """``share()`` (the beam-fork path) increfs blocks that already
+        have a live owner — works with the prefix cache off, flips the
+        stats split to shared, and refuses unowned or null blocks."""
+        a = BlockAllocator(num_blocks=9, block_size=4, prefix_cache=False)
+        b = a.allocate(2)
+        a.share(b)
+        st = a.stats()
+        assert st["shared"] == 2 and st["private"] == 0
+        assert all(a.refcount(blk) == 2 for blk in b)
+        a.free(b)                   # first owner releases
+        assert a.stats()["private"] == 2
+        a.free(b)                   # second owner releases
+        assert a.in_use == 0
+        assert a.free_blocks == a.capacity
+        with pytest.raises(ValueError):
+            a.share(b)              # no live owner anymore
+        with pytest.raises(ValueError):
+            a.share([0])            # the null block never has an owner
+
     def test_randomized_allocator_invariants(self):
         """Property test over random allocate/match/free/reset traffic,
-        with disagg remote registration mixed in: refcounts track live
+        with disagg remote registration, beam-fork ``share()``, and
+        speculative multi-block append mixed in: refcounts track live
         table membership exactly (never negative, shared iff >= 2
         tables), free+cached+in_use == num_blocks-1 at every step,
         allocation never hands out a block a live table still
-        references, the null block never escapes, transfer-imported
-        marks only ever sit on non-free blocks, and a double-import of
-        an already-indexed hash dedups (first registration wins, the
-        duplicate recycles plain)."""
+        references, the null block never escapes (a rejected draft
+        write routes THROUGH block 0 but can never allocate it),
+        transfer-imported marks only ever sit on non-free blocks, and a
+        double-import of an already-indexed hash dedups (first
+        registration wins, the duplicate recycles plain)."""
         rng = np.random.RandomState(SEED)
         a = BlockAllocator(num_blocks=17, block_size=2, prefix_cache=True)
         # a small prompt pool makes matches and sharing frequent
@@ -231,8 +253,30 @@ class TestAllocatorPrefixCache:
         tables = {}
         next_id = 0
         for _step in range(400):
-            op = rng.randint(0, 12)
-            if op < 5:
+            op = rng.randint(0, 14)
+            if op == 12 and tables:
+                # beam fork: a sibling hypothesis shares a live table's
+                # full blocks wholesale — pure incref, no allocation
+                tid = list(tables)[rng.randint(len(tables))]
+                a.share(tables[tid])
+                tables[next_id] = list(tables[tid])
+                next_id += 1
+            elif op == 13 and tables:
+                # speculative multi-token append: one verify step may
+                # commit up to 1 + spec_tokens positions, growing the
+                # table by several blocks at once
+                tid = list(tables)[rng.randint(len(tables))]
+                grow = int(rng.randint(1, 4))
+                try:
+                    fresh = a.allocate(grow)
+                except BlocksExhaustedError:
+                    pass
+                else:
+                    held = {blk for t in tables.values() for blk in t}
+                    assert not set(fresh) & held
+                    assert 0 not in fresh
+                    tables[tid] = tables[tid] + fresh
+            elif op < 5:
                 toks = prompts[rng.randint(len(prompts))]
                 hs = _hashes(toks, 2)
                 matched = a.match(hs)
